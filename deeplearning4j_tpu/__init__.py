@@ -1,0 +1,29 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A ground-up JAX/XLA/pjit/Pallas re-design with the capabilities of Eclipse
+Deeplearning4j (reference: allwefantasy/deeplearning4j @ v0.9.2-SNAPSHOT):
+configuration-driven layer library, sequential (MultiLayerNetwork) and DAG
+(ComputationGraph) models, single-compiled-executable training steps, full
+evaluation / early-stopping / checkpointing tooling, and mesh-sharded
+data/tensor parallelism replacing ParallelWrapper / Spark masters / Aeron
+parameter server with XLA collectives over ICI/DCN.
+
+Where the reference dispatches per-op JNI kernels (SURVEY.md §3.1), this
+framework traces the whole ``step(params, opt_state, batch)`` into one XLA
+executable with HBM-resident parameters.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.nn import activations, initializers, losses
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.config import LayerConfig, layer_registry
+
+__all__ = [
+    "InputType",
+    "LayerConfig",
+    "layer_registry",
+    "activations",
+    "initializers",
+    "losses",
+]

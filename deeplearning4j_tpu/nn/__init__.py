@@ -1,0 +1,8 @@
+"""Neural-network core: configs, activations, initializers, losses, layers.
+
+TPU-native analogue of the reference's ``deeplearning4j-nn`` module
+(/root/reference/deeplearning4j-nn, SURVEY.md §2.1): the config DSL is kept
+(dataclasses + JSON round-trip), but forward/backward become pure JAX
+functions differentiated by autodiff instead of hand-written
+``backpropGradient`` methods.
+"""

@@ -1,0 +1,158 @@
+"""Activation functions.
+
+Capability parity with the reference's ND4J ``IActivation`` set (consumed by
+every layer config via ``activation="relu"`` etc., see e.g.
+/root/reference/deeplearning4j-nn/src/main/java/org/deeplearning4j/nn/conf/layers/BaseLayer
+usage). On TPU an activation is just a traced elementwise function — XLA
+fuses it into the surrounding matmul/conv, so there is no IActivation object
+hierarchy, only a name → function registry (names kept DL4J-compatible,
+lowercase).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+Activation = Callable[[jax.Array], jax.Array]
+
+_REGISTRY: Dict[str, Activation] = {}
+
+
+def register(name: str) -> Callable[[Activation], Activation]:
+    def deco(fn: Activation) -> Activation:
+        _REGISTRY[name.lower()] = fn
+        return fn
+
+    return deco
+
+
+def get(name_or_fn) -> Activation:
+    """Resolve an activation by DL4J-style name (case-insensitive) or pass
+    through a callable (the SameDiff-style custom-activation escape hatch)."""
+    if callable(name_or_fn):
+        return name_or_fn
+    key = str(name_or_fn).lower()
+    if key not in _REGISTRY:
+        raise ValueError(
+            f"Unknown activation '{name_or_fn}'. Known: {sorted(_REGISTRY)}"
+        )
+    return _REGISTRY[key]
+
+
+def names() -> list:
+    return sorted(_REGISTRY)
+
+
+@register("identity")
+def identity(x):
+    return x
+
+
+_REGISTRY["linear"] = identity
+
+
+@register("relu")
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@register("relu6")
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+@register("leakyrelu")
+def leakyrelu(x):
+    return jax.nn.leaky_relu(x, negative_slope=0.01)
+
+
+@register("elu")
+def elu(x):
+    return jax.nn.elu(x)
+
+
+@register("selu")
+def selu(x):
+    return jax.nn.selu(x)
+
+
+@register("sigmoid")
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@register("hardsigmoid")
+def hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+@register("tanh")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@register("hardtanh")
+def hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+@register("rationaltanh")
+def rationaltanh(x):
+    # 1.7159 * tanh(2x/3) rational approximation used by DL4J's
+    # ActivationRationalTanh.
+    a = jnp.abs(2.0 * x / 3.0)
+    approx = 1.0 - 1.0 / (1.0 + a + a * a + 1.41645 * a**4)
+    return 1.7159 * jnp.sign(x) * approx
+
+
+@register("rectifiedtanh")
+def rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+@register("softmax")
+def softmax(x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+@register("logsoftmax")
+def logsoftmax(x):
+    return jax.nn.log_softmax(x, axis=-1)
+
+
+@register("softplus")
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+@register("softsign")
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@register("cube")
+def cube(x):
+    return x * x * x
+
+
+@register("swish")
+def swish(x):
+    return jax.nn.silu(x)
+
+
+@register("gelu")
+def gelu(x):
+    return jax.nn.gelu(x)
+
+
+@register("mish")
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@register("thresholdedrelu")
+def thresholdedrelu(x):
+    return jnp.where(x > 1.0, x, 0.0)

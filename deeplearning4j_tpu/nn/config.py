@@ -1,0 +1,229 @@
+"""Layer-config base classes, registry, and JSON serde.
+
+This is the TPU-native replacement for the reference's config DSL
+(/root/reference/deeplearning4j-nn/src/main/java/org/deeplearning4j/nn/conf/NeuralNetConfiguration.java:82
+and the ~45 classes under nn/conf/layers/): frozen dataclasses that
+round-trip to JSON with polymorphic ``@type`` tags (the reference uses
+Jackson subtype registration, NeuralNetConfiguration.java:405-430).
+
+Config IS the API: a model is a list/DAG of these configs; ``init`` builds a
+params pytree, ``apply`` is a pure traced function. There are no stateful
+layer objects and no per-layer ``backpropGradient`` — autodiff of the whole
+step replaces the reference's hand-written backward passes.
+
+Layer contract
+--------------
+- ``output_type(input_type) -> InputType``      config-time shape inference
+- ``init(key, input_type, dtype) -> params``    parameter pytree (dict)
+- ``init_state(input_type) -> state``           non-trainable state (e.g. BN
+                                                running stats); {} if none
+- ``apply(params, state, x, *, train, rng, mask) -> (y, new_state)``
+- ``propagate_mask(mask, input_type) -> mask``  mask flow (default identity)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import activations, initializers
+from deeplearning4j_tpu.nn.initializers import Distribution
+from deeplearning4j_tpu.nn.input_type import InputType
+
+layer_registry: Dict[str, type] = {}
+
+
+def register_layer(type_name: str):
+    """Class decorator registering a layer config under a stable JSON tag."""
+
+    def deco(cls):
+        cls._type_name = type_name
+        layer_registry[type_name] = cls
+        return cls
+
+    return deco
+
+
+def _encode_value(v):
+    if isinstance(v, Distribution):
+        return {"@distribution": v.to_dict()}
+    if isinstance(v, InputType):
+        return {"@input_type": v.to_dict()}
+    if isinstance(v, LayerConfig):
+        return v.to_dict()
+    if isinstance(v, (tuple, list)):
+        return [_encode_value(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _encode_value(x) for k, x in v.items()}
+    if callable(v):
+        # Custom activation/init functions can't round-trip; store a marker.
+        return {"@callable": getattr(v, "__name__", "lambda")}
+    return v
+
+
+def _decode_value(v):
+    if isinstance(v, dict):
+        if "@distribution" in v:
+            return Distribution.from_dict(v["@distribution"])
+        if "@input_type" in v:
+            return InputType.from_dict(v["@input_type"])
+        if "@type" in v:
+            return layer_from_dict(v)
+        if "@callable" in v:
+            raise ValueError(
+                f"Config contained a non-serializable callable '{v['@callable']}'; "
+                "it cannot be restored from JSON."
+            )
+        return {k: _decode_value(x) for k, x in v.items()}
+    if isinstance(v, list):
+        # JSON arrays come back as lists; configs store shape-like fields as
+        # tuples (kernel, stride, padding, shape) — normalize so a config
+        # round-trips to an EQUAL dataclass.
+        return tuple(_decode_value(x) for x in v)
+    return v
+
+
+def layer_from_dict(d: dict) -> "LayerConfig":
+    tag = d.get("@type")
+    if tag not in layer_registry:
+        raise ValueError(f"Unknown layer type '{tag}'. Known: {sorted(layer_registry)}")
+    cls = layer_registry[tag]
+    kwargs = {k: _decode_value(v) for k, v in d.items() if k != "@type"}
+    # Dataclass fields may evolve across versions: ignore unknown keys so old
+    # JSON keeps loading (the reference's regression-test contract, SURVEY §4).
+    names = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(kwargs) - names
+    for k in unknown:
+        kwargs.pop(k)
+    cfg = cls(**kwargs)
+    return cfg
+
+
+@dataclass
+class LayerConfig:
+    """Base for all layer configs.
+
+    Mirrors the knobs on the reference's BaseLayer conf (activation, weight
+    init, l1/l2, per-layer updater override, dropout, name) — see
+    nn/conf/layers/BaseLayer.java in the reference.
+    """
+
+    name: Optional[str] = None
+    dropout: float = 0.0            # input dropout, DL4J semantics (keep-prob = 1-dropout)
+    l1: float = 0.0
+    l2: float = 0.0
+    updater: Optional[dict] = None  # per-layer updater override (see training/updaters.py)
+    trainable: bool = True          # False == FrozenLayer wrapper in the reference
+
+    # -- registry / serde --------------------------------------------------
+    _type_name = "base"
+
+    def to_dict(self) -> dict:
+        d = {"@type": self._type_name}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            d[f.name] = _encode_value(v)
+        return d
+
+    def to_json(self, **kw) -> str:
+        return json.dumps(self.to_dict(), **kw)
+
+    @staticmethod
+    def from_dict(d: dict) -> "LayerConfig":
+        return layer_from_dict(d)
+
+    @staticmethod
+    def from_json(s: str) -> "LayerConfig":
+        return layer_from_dict(json.loads(s))
+
+    # -- shape/param contract ---------------------------------------------
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def init(self, key: jax.Array, input_type: InputType, dtype=jnp.float32) -> Dict[str, jax.Array]:
+        return {}
+
+    def init_state(self, input_type: InputType) -> Dict[str, jax.Array]:
+        return {}
+
+    def apply(
+        self,
+        params: Dict[str, jax.Array],
+        state: Dict[str, jax.Array],
+        x: jax.Array,
+        *,
+        train: bool = False,
+        rng: Optional[jax.Array] = None,
+        mask: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        raise NotImplementedError
+
+    def propagate_mask(self, mask, input_type: InputType):
+        return mask
+
+    # -- helpers -----------------------------------------------------------
+    def activation_fn(self):
+        return activations.get(getattr(self, "activation", "identity"))
+
+    def maybe_dropout_input(self, x, train: bool, rng):
+        """Input dropout as configured on the layer (inverted dropout)."""
+        if not train or self.dropout <= 0.0:
+            return x
+        if rng is None:
+            raise ValueError(f"Layer {self.name or self._type_name}: dropout requires an rng key")
+        keep = 1.0 - self.dropout
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+    # Param names treated as bias-class (excluded from l1/l2 by default, as in
+    # the reference where regularization applies to weight-class params only;
+    # cf. DefaultParamInitializer BIAS_KEY / BatchNormalizationParamInitializer).
+    BIAS_PARAM_NAMES = frozenset({"b", "vb", "bias", "beta", "gamma"})
+
+    def regularization_penalty(self, params: Dict[str, jax.Array]) -> jax.Array:
+        """L1/L2 penalty over this layer's weight-class params. Recurses into
+        nested param dicts (wrapper layers like Bidirectional)."""
+        pen = jnp.asarray(0.0, jnp.float32)
+        if self.l1 == 0.0 and self.l2 == 0.0:
+            return pen
+
+        def visit(p):
+            nonlocal pen
+            for pname, v in p.items():
+                if isinstance(v, dict):
+                    visit(v)
+                    continue
+                if pname in self.BIAS_PARAM_NAMES:
+                    continue
+                if self.l1:
+                    pen = pen + self.l1 * jnp.sum(jnp.abs(v))
+                if self.l2:
+                    pen = pen + 0.5 * self.l2 * jnp.sum(v * v)
+
+        visit(params)
+        return pen
+
+    def has_params(self, input_type: InputType) -> bool:
+        key = jax.random.PRNGKey(0)
+        return bool(self.init(key, input_type))
+
+
+@dataclass
+class FeedForwardLayerConfig(LayerConfig):
+    """Base for layers with n_in/n_out + activation + weight init."""
+
+    n_in: Optional[int] = None     # inferred from the previous layer when None
+    n_out: int = 0
+    activation: Any = "identity"
+    weight_init: Any = "xavier"
+    bias_init: float = 0.0
+
+    def with_n_in(self, n_in: int):
+        if self.n_in is None:
+            return dataclasses.replace(self, n_in=int(n_in))
+        return self

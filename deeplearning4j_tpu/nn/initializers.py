@@ -1,0 +1,200 @@
+"""Weight initialization schemes.
+
+Parity with the reference's ``WeightInit`` enum and ``WeightInitUtil``
+(/root/reference/deeplearning4j-nn/src/main/java/org/deeplearning4j/nn/weights/WeightInit.java:68)
+— XAVIER, RELU, LECUN, uniform/normal variants, DISTRIBUTION, IDENTITY —
+expressed as pure ``init(key, shape, fan_in, fan_out) -> Array`` functions so
+they can run inside a jitted init and respect the param sharding they are
+created under.
+
+DL4J semantics notes (WeightInitUtil.java):
+  - XAVIER       = N(0, 2/(fan_in+fan_out))
+  - XAVIER_UNIFORM = U(±sqrt(6/(fan_in+fan_out)))
+  - XAVIER_FAN_IN  = N(0, 1/fan_in)
+  - RELU         = N(0, 2/fan_in)
+  - RELU_UNIFORM = U(±sqrt(6/fan_in))
+  - SIGMOID_UNIFORM = U(±4*sqrt(6/(fan_in+fan_out)))
+  - LECUN_NORMAL = N(0, 1/fan_in); LECUN_UNIFORM = U(±sqrt(3/fan_in))
+  - UNIFORM      = U(±1/sqrt(fan_in))  (legacy default)
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+InitFn = Callable[[jax.Array, Sequence[int], float, float, jnp.dtype], jax.Array]
+
+_REGISTRY: Dict[str, InitFn] = {}
+
+
+def register(name: str):
+    def deco(fn: InitFn) -> InitFn:
+        _REGISTRY[name.lower()] = fn
+        return fn
+
+    return deco
+
+
+def get(name_or_fn) -> InitFn:
+    if callable(name_or_fn):
+        return name_or_fn
+    key = str(name_or_fn).lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"Unknown weight init '{name_or_fn}'. Known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]
+
+
+def names() -> list:
+    return sorted(_REGISTRY)
+
+
+@register("zero")
+def zero(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    return jnp.zeros(shape, dtype)
+
+
+@register("ones")
+def ones(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    return jnp.ones(shape, dtype)
+
+
+@register("normal")
+def normal(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    # DL4J NORMAL: N(0, 1/sqrt(fan_in))
+    std = 1.0 / math.sqrt(max(fan_in, 1.0))
+    return std * jax.random.normal(key, shape, dtype)
+
+
+@register("uniform")
+def uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    a = 1.0 / math.sqrt(max(fan_in, 1.0))
+    return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+
+
+@register("xavier")
+def xavier(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    std = math.sqrt(2.0 / max(fan_in + fan_out, 1.0))
+    return std * jax.random.normal(key, shape, dtype)
+
+
+@register("xavier_uniform")
+def xavier_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    a = math.sqrt(6.0 / max(fan_in + fan_out, 1.0))
+    return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+
+
+@register("xavier_fan_in")
+def xavier_fan_in(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    std = math.sqrt(1.0 / max(fan_in, 1.0))
+    return std * jax.random.normal(key, shape, dtype)
+
+
+@register("relu")
+def relu(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    std = math.sqrt(2.0 / max(fan_in, 1.0))
+    return std * jax.random.normal(key, shape, dtype)
+
+
+@register("relu_uniform")
+def relu_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    a = math.sqrt(6.0 / max(fan_in, 1.0))
+    return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+
+
+@register("sigmoid_uniform")
+def sigmoid_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    a = 4.0 * math.sqrt(6.0 / max(fan_in + fan_out, 1.0))
+    return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+
+
+@register("lecun_normal")
+def lecun_normal(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    std = math.sqrt(1.0 / max(fan_in, 1.0))
+    return std * jax.random.normal(key, shape, dtype)
+
+
+@register("lecun_uniform")
+def lecun_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    a = math.sqrt(3.0 / max(fan_in, 1.0))
+    return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+
+
+@register("identity")
+def identity_init(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    if len(shape) != 2 or shape[0] != shape[1]:
+        raise ValueError(f"IDENTITY init requires a square 2-D shape, got {shape}")
+    return jnp.eye(shape[0], dtype=dtype)
+
+
+@register("varscaling_normal_fan_in")
+def vs_normal_fan_in(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    return math.sqrt(1.0 / max(fan_in, 1.0)) * jax.random.normal(key, shape, dtype)
+
+
+@register("varscaling_normal_fan_out")
+def vs_normal_fan_out(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    return math.sqrt(1.0 / max(fan_out, 1.0)) * jax.random.normal(key, shape, dtype)
+
+
+@register("varscaling_normal_fan_avg")
+def vs_normal_fan_avg(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    return math.sqrt(2.0 / max(fan_in + fan_out, 1.0)) * jax.random.normal(key, shape, dtype)
+
+
+@dataclass(frozen=True)
+class Distribution:
+    """DL4J WeightInit.DISTRIBUTION equivalent: explicit sampling distribution.
+
+    kind: "normal" | "uniform" | "truncated_normal" | "constant"
+    """
+
+    kind: str = "normal"
+    mean: float = 0.0
+    std: float = 1.0
+    lower: float = -1.0
+    upper: float = 1.0
+    value: float = 0.0
+
+    def __call__(self, key, shape, fan_in, fan_out, dtype=jnp.float32):
+        if self.kind == "normal":
+            return self.mean + self.std * jax.random.normal(key, shape, dtype)
+        if self.kind == "uniform":
+            return jax.random.uniform(key, shape, dtype, minval=self.lower, maxval=self.upper)
+        if self.kind == "truncated_normal":
+            return self.mean + self.std * jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype)
+        if self.kind == "constant":
+            return jnp.full(shape, self.value, dtype)
+        raise ValueError(f"Unknown distribution kind '{self.kind}'")
+
+    def to_dict(self):
+        return {
+            "kind": self.kind,
+            "mean": self.mean,
+            "std": self.std,
+            "lower": self.lower,
+            "upper": self.upper,
+            "value": self.value,
+        }
+
+    @staticmethod
+    def from_dict(d):
+        return Distribution(**d)
+
+
+def initialize(
+    name_or_fn,
+    key: jax.Array,
+    shape: Sequence[int],
+    fan_in: float,
+    fan_out: float,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Initialize one tensor. `name_or_fn` may be a registry name, a
+    Distribution, or any callable with the InitFn signature."""
+    fn = get(name_or_fn)
+    return fn(key, tuple(shape), float(fan_in), float(fan_out), dtype)

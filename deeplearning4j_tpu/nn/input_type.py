@@ -1,0 +1,99 @@
+"""Input types: shape metadata flowing between layers at config time.
+
+Parity with the reference's ``InputType``
+(/root/reference/deeplearning4j-nn/src/main/java/org/deeplearning4j/nn/conf/inputs/InputType.java:117,140,176)
+which drives nIn inference and automatic preprocessor insertion.
+
+TPU-first convention change: convolutional activations are **NHWC**
+([batch, height, width, channels]) and recurrent activations are
+**[batch, time, features]** — the layouts XLA:TPU tiles best — whereas the
+reference uses NCHW and [batch, features, time]. The config surface is
+unchanged; only the runtime layout differs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+
+@dataclass(frozen=True)
+class InputType:
+    """Tagged union: kind in {"ff", "recurrent", "conv", "conv_flat"}."""
+
+    kind: str
+    size: int = 0                      # ff / recurrent feature size
+    timesteps: Optional[int] = None    # recurrent (None = variable)
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    # -- constructors (mirror InputType.feedForward/recurrent/convolutional) --
+    @staticmethod
+    def feed_forward(size: int) -> "InputType":
+        return InputType(kind="ff", size=int(size))
+
+    @staticmethod
+    def recurrent(size: int, timesteps: Optional[int] = None) -> "InputType":
+        return InputType(kind="recurrent", size=int(size), timesteps=timesteps)
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "InputType":
+        return InputType(kind="conv", height=int(height), width=int(width), channels=int(channels))
+
+    @staticmethod
+    def convolutional_flat(height: int, width: int, channels: int) -> "InputType":
+        """Flattened image input (e.g. MNIST rows of 784), like
+        InputType.convolutionalFlat — triggers a reshape preprocessor."""
+        return InputType(
+            kind="conv_flat",
+            size=int(height * width * channels),
+            height=int(height),
+            width=int(width),
+            channels=int(channels),
+        )
+
+    # -- derived ----------------------------------------------------------
+    def flat_size(self) -> int:
+        if self.kind in ("ff", "conv_flat"):
+            return self.size
+        if self.kind == "recurrent":
+            return self.size
+        if self.kind == "conv":
+            return self.height * self.width * self.channels
+        raise ValueError(self.kind)
+
+    def batch_shape(self, batch: int = 1) -> Tuple[int, ...]:
+        """Concrete array shape for a batch of this input type (NHWC / BTF)."""
+        if self.kind in ("ff", "conv_flat"):
+            return (batch, self.size)
+        if self.kind == "recurrent":
+            t = self.timesteps if self.timesteps is not None else 1
+            return (batch, t, self.size)
+        if self.kind == "conv":
+            return (batch, self.height, self.width, self.channels)
+        raise ValueError(self.kind)
+
+    # -- serde ------------------------------------------------------------
+    def to_dict(self) -> dict:
+        d = {"kind": self.kind}
+        if self.kind in ("ff", "conv_flat", "recurrent"):
+            d["size"] = self.size
+        if self.kind == "recurrent":
+            d["timesteps"] = self.timesteps
+        if self.kind in ("conv", "conv_flat"):
+            d.update(height=self.height, width=self.width, channels=self.channels)
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "InputType":
+        kind = d["kind"]
+        if kind == "ff":
+            return InputType.feed_forward(d["size"])
+        if kind == "recurrent":
+            return InputType.recurrent(d["size"], d.get("timesteps"))
+        if kind == "conv":
+            return InputType.convolutional(d["height"], d["width"], d["channels"])
+        if kind == "conv_flat":
+            return InputType.convolutional_flat(d["height"], d["width"], d["channels"])
+        raise ValueError(f"Unknown InputType kind '{kind}'")

@@ -1,0 +1,66 @@
+"""Layer library.
+
+One config dataclass per layer type, registered for JSON serde. Coverage
+targets the reference's nn/conf/layers/ set (~45 classes, SURVEY.md §2.1).
+"""
+
+from deeplearning4j_tpu.nn.layers.core import (
+    ActivationLayer,
+    AutoEncoder,
+    Dense,
+    DropoutLayer,
+    Embedding,
+    LossLayer,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.layers.convolution import (
+    Conv1D,
+    Conv2D,
+    Deconv2D,
+    DepthwiseConv2D,
+    SeparableConv2D,
+    Subsampling1D,
+    Subsampling2D,
+    Upsampling2D,
+    ZeroPadding2D,
+)
+from deeplearning4j_tpu.nn.layers.normalization import BatchNorm, LocalResponseNormalization
+from deeplearning4j_tpu.nn.layers.pooling import GlobalPooling
+from deeplearning4j_tpu.nn.layers.recurrent import (
+    Bidirectional,
+    GravesLSTM,
+    LastTimeStep,
+    LSTM,
+    MaskZero,
+    RnnOutputLayer,
+    SimpleRnn,
+)
+
+__all__ = [
+    "ActivationLayer",
+    "AutoEncoder",
+    "Dense",
+    "DropoutLayer",
+    "Embedding",
+    "LossLayer",
+    "OutputLayer",
+    "Conv1D",
+    "Conv2D",
+    "Deconv2D",
+    "DepthwiseConv2D",
+    "SeparableConv2D",
+    "Subsampling1D",
+    "Subsampling2D",
+    "Upsampling2D",
+    "ZeroPadding2D",
+    "BatchNorm",
+    "LocalResponseNormalization",
+    "GlobalPooling",
+    "Bidirectional",
+    "GravesLSTM",
+    "LastTimeStep",
+    "LSTM",
+    "MaskZero",
+    "RnnOutputLayer",
+    "SimpleRnn",
+]

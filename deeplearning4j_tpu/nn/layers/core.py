@@ -1,0 +1,283 @@
+"""Core feed-forward layers: Dense, Output, Activation, Dropout, Embedding,
+AutoEncoder.
+
+Reference parity: nn/conf/layers/{DenseLayer,OutputLayer,ActivationLayer,
+DropoutLayer,EmbeddingLayer,AutoEncoder}.java and their impls under
+nn/layers/ (e.g. feedforward/embedding/EmbeddingLayer.java). Forward math is
+a single fused matmul+bias+activation per layer; backward comes from
+autodiff of the whole step (no per-layer backpropGradient).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import initializers, losses
+from deeplearning4j_tpu.nn.config import FeedForwardLayerConfig, LayerConfig, register_layer
+from deeplearning4j_tpu.nn.input_type import InputType
+
+
+@register_layer("dense")
+@dataclass
+class Dense(FeedForwardLayerConfig):
+    """Fully connected layer: act(x @ W + b).
+
+    Parity: nn/conf/layers/DenseLayer.java. Accepts rank-2 [batch, feat] or
+    rank-3 [batch, time, feat] input (the reference inserts preprocessors for
+    the latter; here the matmul is batched over leading axes natively, which
+    XLA maps onto the MXU in one pass).
+    """
+
+    has_bias: bool = True
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind == "recurrent":
+            return InputType.recurrent(self.n_out, input_type.timesteps)
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        n_in = self.n_in if self.n_in is not None else input_type.flat_size()
+        kW, _ = jax.random.split(key)
+        params = {
+            "W": initializers.initialize(self.weight_init, kW, (n_in, self.n_out), n_in, self.n_out, dtype)
+        }
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return params
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        y = x @ params["W"]
+        if self.has_bias:
+            y = y + params["b"]
+        return self.activation_fn()(y), state
+
+    def preactivation(self, params, x):
+        y = x @ params["W"]
+        if self.has_bias:
+            y = y + params["b"]
+        return y
+
+
+@register_layer("output")
+@dataclass
+class OutputLayer(Dense):
+    """Dense + loss head. Parity: nn/conf/layers/OutputLayer.java.
+
+    The model computes the loss via :meth:`score` on the PRE-activation so the
+    (softmax, mcxent) pair is fused into a stable log-softmax form
+    (losses.per_example_scores).
+    """
+
+    loss: Any = "mcxent"
+
+    def score(self, params, x, labels, mask=None, average=True, weights=None):
+        preact = self.preactivation(params, x)
+        act = getattr(self, "activation", "identity")
+        if average:
+            return losses.average_score(self.loss, labels, preact, act, mask, weights)
+        return losses.per_example_scores(self.loss, labels, preact, act, mask, weights)
+
+
+@register_layer("loss")
+@dataclass
+class LossLayer(LayerConfig):
+    """Parameter-free loss head (LossLayer.java): applies activation + loss to
+    its input unchanged."""
+
+    activation: Any = "identity"
+    loss: Any = "mcxent"
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return self.activation_fn()(x), state
+
+    def score(self, params, x, labels, mask=None, average=True, weights=None):
+        if average:
+            return losses.average_score(self.loss, labels, x, self.activation, mask, weights)
+        return losses.per_example_scores(self.loss, labels, x, self.activation, mask, weights)
+
+
+@register_layer("activation")
+@dataclass
+class ActivationLayer(LayerConfig):
+    """Standalone activation (ActivationLayer.java)."""
+
+    activation: Any = "relu"
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return self.activation_fn()(x), state
+
+
+@register_layer("dropout")
+@dataclass
+class DropoutLayer(LayerConfig):
+    """Standalone inverted dropout (DropoutLayer.java / conf/dropout/Dropout).
+
+    `dropout` is the DROP probability, DL4J-style; identity at inference.
+    """
+
+    dropout: float = 0.5
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return self.maybe_dropout_input(x, train, rng), state
+
+
+@register_layer("gaussian_noise")
+@dataclass
+class GaussianNoise(LayerConfig):
+    """Additive gaussian noise (conf/dropout/GaussianNoise.java)."""
+
+    stddev: float = 0.1
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        if not train or self.stddev <= 0.0:
+            return x, state
+        if rng is None:
+            raise ValueError("GaussianNoise requires an rng key in training mode")
+        return x + self.stddev * jax.random.normal(rng, x.shape, x.dtype), state
+
+
+@register_layer("gaussian_dropout")
+@dataclass
+class GaussianDropout(LayerConfig):
+    """Multiplicative gaussian noise (conf/dropout/GaussianDropout.java):
+    x * N(1, rate/(1-rate))."""
+
+    rate: float = 0.5
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        if not train or self.rate <= 0.0:
+            return x, state
+        if rng is None:
+            raise ValueError("GaussianDropout requires an rng key in training mode")
+        std = (self.rate / (1.0 - self.rate)) ** 0.5
+        return x * (1.0 + std * jax.random.normal(rng, x.shape, x.dtype)), state
+
+
+@register_layer("alpha_dropout")
+@dataclass
+class AlphaDropout(LayerConfig):
+    """SELU-preserving dropout (conf/dropout/AlphaDropout.java)."""
+
+    dropout: float = 0.5
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        if not train or self.dropout <= 0.0:
+            return x, state
+        if rng is None:
+            raise ValueError("AlphaDropout requires an rng key in training mode")
+        p_keep = 1.0 - self.dropout
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(rng, p_keep, x.shape)
+        a = (p_keep + alpha_p**2 * p_keep * (1 - p_keep)) ** -0.5
+        b = -a * alpha_p * (1 - p_keep)
+        return a * jnp.where(keep, x, alpha_p) + b, state
+
+
+@register_layer("embedding")
+@dataclass
+class Embedding(FeedForwardLayerConfig):
+    """Embedding lookup (feedforward/embedding/EmbeddingLayer.java): input is
+    integer indices [batch] or [batch, 1]; output [batch, n_out].
+
+    TPU note: lookup is a gather (one-hot matmul for tiny vocabularies would
+    also hit the MXU, but XLA's gather is fine here); backward produces a
+    scatter-add, which XLA handles natively — no special 'embedding updater'.
+    """
+
+    has_bias: bool = False
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        n_in = self.n_in if self.n_in is not None else input_type.flat_size()
+        kW, _ = jax.random.split(key)
+        params = {
+            "W": initializers.initialize(self.weight_init, kW, (n_in, self.n_out), n_in, self.n_out, dtype)
+        }
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return params
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 2 and idx.shape[-1] == 1:
+            idx = idx[:, 0]
+        y = jnp.take(params["W"], idx, axis=0)
+        if self.has_bias:
+            y = y + params["b"]
+        return self.activation_fn()(y), state
+
+
+@register_layer("embedding_sequence")
+@dataclass
+class EmbeddingSequence(FeedForwardLayerConfig):
+    """Sequence embedding: int [batch, time] -> [batch, time, n_out]."""
+
+    has_bias: bool = False
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, input_type.timesteps)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        n_in = self.n_in if self.n_in is not None else input_type.flat_size()
+        kW, _ = jax.random.split(key)
+        return {
+            "W": initializers.initialize(self.weight_init, kW, (n_in, self.n_out), n_in, self.n_out, dtype)
+        }
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 3 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        return jnp.take(params["W"], idx, axis=0), state
+
+
+@register_layer("autoencoder")
+@dataclass
+class AutoEncoder(FeedForwardLayerConfig):
+    """Denoising autoencoder layer (conf/layers/AutoEncoder.java).
+
+    Supervised-path behavior matches the reference: acts as a Dense encoder.
+    :meth:`reconstruct` exposes encode→decode with tied-ish params (separate
+    decoder weights, like the reference's w/vb params); corruption_level is
+    the input-corruption fraction used during unsupervised pretraining.
+    """
+
+    corruption_level: float = 0.3
+    activation: Any = "sigmoid"
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        n_in = self.n_in if self.n_in is not None else input_type.flat_size()
+        kW, kV = jax.random.split(key)
+        return {
+            "W": initializers.initialize(self.weight_init, kW, (n_in, self.n_out), n_in, self.n_out, dtype),
+            "b": jnp.full((self.n_out,), self.bias_init, dtype),
+            "vb": jnp.zeros((n_in,), dtype),  # visible bias for the decode path
+        }
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        return self.activation_fn()(x @ params["W"] + params["b"]), state
+
+    def encode(self, params, x):
+        return self.activation_fn()(x @ params["W"] + params["b"])
+
+    def decode(self, params, h):
+        return self.activation_fn()(h @ params["W"].T + params["vb"])
+
+    def reconstruct(self, params, x, *, rng=None, corrupt=False):
+        if corrupt and rng is not None and self.corruption_level > 0:
+            keep = jax.random.bernoulli(rng, 1.0 - self.corruption_level, x.shape)
+            x = jnp.where(keep, x, 0.0)
+        return self.decode(params, self.encode(params, x))

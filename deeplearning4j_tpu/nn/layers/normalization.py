@@ -1,0 +1,102 @@
+"""Normalization layers: BatchNorm, LRN.
+
+Reference parity: nn/conf/layers/BatchNormalization.java +
+nn/layers/normalization/{BatchNormalization,LocalResponseNormalization}.java
+and their cuDNN helpers (CudnnBatchNormalizationHelper.java). On TPU these
+are plain fused elementwise/reduction graphs; running statistics live in the
+non-trainable ``state`` pytree (the flax ``batch_stats`` pattern) rather
+than being updated in-place.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.config import LayerConfig, register_layer
+from deeplearning4j_tpu.nn.input_type import InputType
+
+
+@register_layer("batch_norm")
+@dataclass
+class BatchNorm(LayerConfig):
+    """Batch normalization over the channel/feature axis (last axis, NHWC).
+
+    DL4J defaults (BatchNormalization.java): decay=0.9 ('momentum' of the
+    running stats EMA), eps=1e-5, lockGammaBeta=False.
+    """
+
+    decay: float = 0.9
+    eps: float = 1e-5
+    use_gamma_beta: bool = True   # lockGammaBeta=True in DL4J means fixed 1/0
+    gamma_init: float = 1.0
+    beta_init: float = 0.0
+
+    def _nfeat(self, input_type: InputType) -> int:
+        return input_type.channels if input_type.kind == "conv" else input_type.flat_size()
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        n = self._nfeat(input_type)
+        if not self.use_gamma_beta:
+            return {}
+        return {
+            "gamma": jnp.full((n,), self.gamma_init, dtype),
+            "beta": jnp.full((n,), self.beta_init, dtype),
+        }
+
+    def init_state(self, input_type: InputType):
+        n = self._nfeat(input_type)
+        return {
+            "mean": jnp.zeros((n,), jnp.float32),
+            "var": jnp.ones((n,), jnp.float32),
+        }
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        axes = tuple(range(x.ndim - 1))  # all but channel/feature axis
+        if train:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1.0 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1.0 - self.decay) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = lax.rsqrt(var + self.eps)
+        y = (x - mean) * inv
+        if self.use_gamma_beta and params:
+            y = y * params["gamma"] + params["beta"]
+        return y, new_state
+
+
+@register_layer("lrn")
+@dataclass
+class LocalResponseNormalization(LayerConfig):
+    """Local response normalization across channels (LocalResponseNormalization.java).
+
+    DL4J defaults: k=2, n=5, alpha=1e-4, beta=0.75.
+    """
+
+    k: float = 2.0
+    n: int = 5
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        # Sum x^2 over a window of `n` adjacent channels (last axis, NHWC).
+        half = self.n // 2
+        sq = x * x
+        # reduce_window over channel axis
+        window = (1,) * (x.ndim - 1) + (self.n,)
+        strides = (1,) * x.ndim
+        pads = tuple(
+            (0, 0) if i < x.ndim - 1 else (half, self.n - 1 - half) for i in range(x.ndim)
+        )
+        ssum = lax.reduce_window(sq, 0.0, lax.add, window, strides, pads)
+        denom = (self.k + self.alpha * ssum) ** self.beta
+        return x / denom, state

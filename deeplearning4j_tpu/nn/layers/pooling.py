@@ -1,0 +1,66 @@
+"""Global pooling (GlobalPoolingLayer.java): reduce over time ([b,t,f]->[b,f])
+or spatial dims ([b,h,w,c]->[b,c]); MAX | AVG | SUM | PNORM; mask-aware for
+time-series input like the reference's masked pooling
+(util/MaskedReductionUtil.java)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.config import LayerConfig, register_layer
+from deeplearning4j_tpu.nn.input_type import InputType
+
+
+@register_layer("global_pooling")
+@dataclass
+class GlobalPooling(LayerConfig):
+    pooling: str = "max"
+    pnorm: int = 2
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind == "recurrent":
+            return InputType.feed_forward(input_type.size)
+        if input_type.kind == "conv":
+            return InputType.feed_forward(input_type.channels)
+        return input_type
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        if x.ndim == 3:  # [b, t, f], reduce over time with mask
+            axes = (1,)
+            if mask is not None:
+                m = mask[..., None].astype(x.dtype)
+                if self.pooling == "max":
+                    neg = jnp.asarray(-jnp.inf, x.dtype)
+                    y = jnp.max(jnp.where(m > 0, x, neg), axis=1)
+                elif self.pooling in ("avg", "mean"):
+                    y = jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+                elif self.pooling == "sum":
+                    y = jnp.sum(x * m, axis=1)
+                elif self.pooling == "pnorm":
+                    p = float(self.pnorm)
+                    y = jnp.sum((jnp.abs(x) * m) ** p, axis=1) ** (1.0 / p)
+                else:
+                    raise ValueError(self.pooling)
+                return y, state
+        elif x.ndim == 4:  # [b, h, w, c]
+            axes = (1, 2)
+        else:
+            raise ValueError(f"GlobalPooling expects rank 3 or 4 input, got {x.shape}")
+
+        if self.pooling == "max":
+            y = jnp.max(x, axis=axes)
+        elif self.pooling in ("avg", "mean"):
+            y = jnp.mean(x, axis=axes)
+        elif self.pooling == "sum":
+            y = jnp.sum(x, axis=axes)
+        elif self.pooling == "pnorm":
+            p = float(self.pnorm)
+            y = jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p)
+        else:
+            raise ValueError(self.pooling)
+        return y, state
+
+    def propagate_mask(self, mask, input_type):
+        return None

@@ -1,0 +1,365 @@
+"""Recurrent layers: LSTM, GravesLSTM (peepholes), SimpleRnn, Bidirectional,
+LastTimeStep, MaskZero, RnnOutputLayer.
+
+Reference parity: the shared fwd/bwd in
+/root/reference/deeplearning4j-nn/src/main/java/org/deeplearning4j/nn/layers/recurrent/LSTMHelpers.java:69,393
+(used by LSTM / GravesLSTM / GravesBidirectionalLSTM) and the cuDNN fused
+path (CudnnLSTMHelper.java). TPU-native design: the time loop is a single
+``lax.scan`` whose body is one fused [x,h] @ W matmul on the MXU; backward
+comes from autodiff of the scan (XLA keeps the whole unrolled graph on
+device — no per-timestep kernel dispatch).
+
+Layout: [batch, time, features] (the reference uses [batch, features, time]).
+Masking: mask [batch, time] — masked steps pass the carry through unchanged
+and output zeros, matching the reference's masked RNN semantics.
+
+Streaming/tBPTT: every recurrent layer exposes
+``initial_carry(batch)`` and ``apply_seq(params, x, carry, mask) ->
+(out, new_carry)`` so truncated BPTT is scan-over-chunks with carried state
+(SURVEY.md §5.7) and ``rnnTimeStep`` is a one-step call with a stored carry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn import initializers, losses
+from deeplearning4j_tpu.nn.config import FeedForwardLayerConfig, LayerConfig, register_layer
+from deeplearning4j_tpu.nn.input_type import InputType
+
+
+def _mask_step(mask_t, new, old):
+    """Where mask_t==0, keep `old`; else `new`. mask_t: [batch]."""
+    m = mask_t[:, None]
+    return jnp.where(m > 0, new, old)
+
+
+@dataclass
+class BaseRecurrent(FeedForwardLayerConfig):
+    """Common recurrent scaffolding."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, input_type.timesteps)
+
+    def initial_carry(self, batch: int, dtype=jnp.float32):
+        raise NotImplementedError
+
+    def apply_seq(self, params, x, carry, mask=None):
+        raise NotImplementedError
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        carry = self.initial_carry(x.shape[0], x.dtype)
+        y, _ = self.apply_seq(params, x, carry, mask)
+        return y, state
+
+
+@register_layer("lstm")
+@dataclass
+class LSTM(BaseRecurrent):
+    """Standard (non-peephole) LSTM — parity with nn/conf/layers/LSTM.java.
+
+    Gate order in the fused kernel: [i, f, g, o] (Keras order, which makes
+    Keras h5 import a pure reshape). DL4J's forgetGateBiasInit default of 1.0
+    is kept.
+    """
+
+    activation: Any = "tanh"
+    gate_activation: Any = "sigmoid"
+    forget_gate_bias_init: float = 1.0
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        n_in = self.n_in if self.n_in is not None else input_type.size
+        H = self.n_out
+        kx, kh = jax.random.split(key)
+        Wx = initializers.initialize(self.weight_init, kx, (n_in, 4 * H), n_in, H, dtype)
+        Wh = initializers.initialize(self.weight_init, kh, (H, 4 * H), H, H, dtype)
+        b = jnp.zeros((4 * H,), dtype)
+        # forget-gate block is the second quarter [H:2H]
+        b = b.at[H : 2 * H].set(self.forget_gate_bias_init)
+        return {"Wx": Wx, "Wh": Wh, "b": b}
+
+    def initial_carry(self, batch: int, dtype=jnp.float32):
+        H = self.n_out
+        return (jnp.zeros((batch, H), dtype), jnp.zeros((batch, H), dtype))
+
+    def _gates(self, params, x_t, h):
+        z = x_t @ params["Wx"] + h @ params["Wh"] + params["b"]
+        H = self.n_out
+        from deeplearning4j_tpu.nn import activations as A
+
+        gate = A.get(self.gate_activation)
+        act = A.get(self.activation)
+        i = gate(z[:, 0 * H : 1 * H])
+        f = gate(z[:, 1 * H : 2 * H])
+        g = act(z[:, 2 * H : 3 * H])
+        o = gate(z[:, 3 * H : 4 * H])
+        return i, f, g, o
+
+    def apply_seq(self, params, x, carry, mask=None):
+        from deeplearning4j_tpu.nn import activations as A
+
+        act = A.get(self.activation)
+
+        def step(c, inp):
+            h, cell = c
+            if mask is None:
+                x_t = inp
+            else:
+                x_t, m_t = inp
+            i, f, g, o = self._gates(params, x_t, h)
+            new_cell = f * cell + i * g
+            new_h = o * act(new_cell)
+            if mask is not None:
+                new_cell = _mask_step(m_t, new_cell, cell)
+                new_h = _mask_step(m_t, new_h, h)
+                out = new_h * m_t[:, None]
+            else:
+                out = new_h
+            return (new_h, new_cell), out
+
+        xs = jnp.swapaxes(x, 0, 1)  # [time, batch, feat] for scan
+        if mask is not None:
+            ms = jnp.swapaxes(mask.astype(x.dtype), 0, 1)
+            (h, cell), outs = lax.scan(step, carry, (xs, ms))
+        else:
+            (h, cell), outs = lax.scan(step, carry, xs)
+        return jnp.swapaxes(outs, 0, 1), (h, cell)
+
+
+@register_layer("graves_lstm")
+@dataclass
+class GravesLSTM(LSTM):
+    """LSTM with peephole connections — parity with GravesLSTM.java
+    (LSTMHelpers.java applies peepholes from c_{t-1} to i,f and c_t to o)."""
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        params = super().init(key, input_type, dtype)
+        H = self.n_out
+        params["peephole"] = jnp.zeros((3 * H,), dtype)  # [p_i, p_f, p_o]
+        return params
+
+    def apply_seq(self, params, x, carry, mask=None):
+        from deeplearning4j_tpu.nn import activations as A
+
+        act = A.get(self.activation)
+        gate = A.get(self.gate_activation)
+        H = self.n_out
+        p = params["peephole"]
+        p_i, p_f, p_o = p[:H], p[H : 2 * H], p[2 * H :]
+
+        def step(c, inp):
+            h, cell = c
+            if mask is None:
+                x_t = inp
+            else:
+                x_t, m_t = inp
+            z = x_t @ params["Wx"] + h @ params["Wh"] + params["b"]
+            i = gate(z[:, 0 * H : 1 * H] + cell * p_i)
+            f = gate(z[:, 1 * H : 2 * H] + cell * p_f)
+            g = act(z[:, 2 * H : 3 * H])
+            new_cell = f * cell + i * g
+            o = gate(z[:, 3 * H : 4 * H] + new_cell * p_o)
+            new_h = o * act(new_cell)
+            if mask is not None:
+                new_cell = _mask_step(m_t, new_cell, cell)
+                new_h = _mask_step(m_t, new_h, h)
+                out = new_h * m_t[:, None]
+            else:
+                out = new_h
+            return (new_h, new_cell), out
+
+        xs = jnp.swapaxes(x, 0, 1)
+        if mask is not None:
+            ms = jnp.swapaxes(mask.astype(x.dtype), 0, 1)
+            (h, cell), outs = lax.scan(step, carry, (xs, ms))
+        else:
+            (h, cell), outs = lax.scan(step, carry, xs)
+        return jnp.swapaxes(outs, 0, 1), (h, cell)
+
+
+@register_layer("simple_rnn")
+@dataclass
+class SimpleRnn(BaseRecurrent):
+    """Elman RNN: h_t = act(x_t Wx + h_{t-1} Wh + b) (SimpleRnn.java)."""
+
+    activation: Any = "tanh"
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        n_in = self.n_in if self.n_in is not None else input_type.size
+        H = self.n_out
+        kx, kh = jax.random.split(key)
+        return {
+            "Wx": initializers.initialize(self.weight_init, kx, (n_in, H), n_in, H, dtype),
+            "Wh": initializers.initialize(self.weight_init, kh, (H, H), H, H, dtype),
+            "b": jnp.full((H,), self.bias_init, dtype),
+        }
+
+    def initial_carry(self, batch: int, dtype=jnp.float32):
+        return jnp.zeros((batch, self.n_out), dtype)
+
+    def apply_seq(self, params, x, carry, mask=None):
+        act = self.activation_fn()
+
+        def step(h, inp):
+            if mask is None:
+                x_t = inp
+            else:
+                x_t, m_t = inp
+            new_h = act(x_t @ params["Wx"] + h @ params["Wh"] + params["b"])
+            if mask is not None:
+                new_h = _mask_step(m_t, new_h, h)
+                out = new_h * m_t[:, None]
+            else:
+                out = new_h
+            return new_h, out
+
+        xs = jnp.swapaxes(x, 0, 1)
+        if mask is not None:
+            ms = jnp.swapaxes(mask.astype(x.dtype), 0, 1)
+            h, outs = lax.scan(step, carry, (xs, ms))
+        else:
+            h, outs = lax.scan(step, carry, xs)
+        return jnp.swapaxes(outs, 0, 1), h
+
+
+@register_layer("bidirectional")
+@dataclass
+class Bidirectional(LayerConfig):
+    """Bidirectional wrapper (conf/layers/recurrent/Bidirectional.java +
+    GravesBidirectionalLSTM): runs the wrapped RNN forward and over the
+    time-reversed sequence, combining with CONCAT | ADD | MUL | AVERAGE."""
+
+    rnn: Optional[LayerConfig] = None
+    mode: str = "concat"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        inner = self.rnn.output_type(input_type)
+        if self.mode == "concat":
+            return InputType.recurrent(inner.size * 2, inner.timesteps)
+        return inner
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        kf, kb = jax.random.split(key)
+        return {
+            "fwd": self.rnn.init(kf, input_type, dtype),
+            "bwd": self.rnn.init(kb, input_type, dtype),
+        }
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        # Input dropout: honor both the wrapper's and the wrapped RNN's
+        # configured dropout (apply_seq bypasses BaseRecurrent.apply).
+        x = self.maybe_dropout_input(x, train, rng)
+        if train and self.rnn.dropout > 0.0:
+            x = self.rnn.maybe_dropout_input(x, train, rng)
+        carry_f = self.rnn.initial_carry(x.shape[0], x.dtype)
+        carry_b = self.rnn.initial_carry(x.shape[0], x.dtype)
+        yf, _ = self.rnn.apply_seq(params["fwd"], x, carry_f, mask)
+        xr = jnp.flip(x, axis=1)
+        mr = jnp.flip(mask, axis=1) if mask is not None else None
+        yb, _ = self.rnn.apply_seq(params["bwd"], xr, carry_b, mr)
+        yb = jnp.flip(yb, axis=1)
+        if self.mode == "concat":
+            return jnp.concatenate([yf, yb], axis=-1), state
+        if self.mode == "add":
+            return yf + yb, state
+        if self.mode == "mul":
+            return yf * yb, state
+        if self.mode in ("average", "avg"):
+            return 0.5 * (yf + yb), state
+        raise ValueError(f"Unknown Bidirectional mode '{self.mode}'")
+
+
+@register_layer("last_time_step")
+@dataclass
+class LastTimeStep(LayerConfig):
+    """Wraps an RNN layer, returning only the last (unmasked) timestep
+    (recurrent/LastTimeStepLayer.java): [b,t,f] -> [b,f]."""
+
+    rnn: Optional[LayerConfig] = None
+
+    def output_type(self, input_type: InputType) -> InputType:
+        inner = self.rnn.output_type(input_type)
+        return InputType.feed_forward(inner.size)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        return self.rnn.init(key, input_type, dtype)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        y, _ = self.rnn.apply(params, {}, x, train=train, rng=rng, mask=mask)
+        if mask is None:
+            out = y[:, -1, :]
+        else:
+            idx = jnp.maximum(jnp.sum(mask > 0, axis=1).astype(jnp.int32) - 1, 0)
+            out = jnp.take_along_axis(y, idx[:, None, None], axis=1)[:, 0, :]
+        return out, state
+
+    def propagate_mask(self, mask, input_type):
+        return None
+
+
+@register_layer("mask_zero")
+@dataclass
+class MaskZero(LayerConfig):
+    """Derives a mask from timesteps equal to `mask_value` and applies the
+    wrapped RNN with it (recurrent/MaskZeroLayer.java)."""
+
+    rnn: Optional[LayerConfig] = None
+    mask_value: float = 0.0
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return self.rnn.output_type(input_type)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        return self.rnn.init(key, input_type, dtype)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        derived = jnp.any(x != self.mask_value, axis=-1).astype(x.dtype)
+        if mask is not None:
+            derived = derived * mask
+        return self.rnn.apply(params, state, x, train=train, rng=rng, mask=derived)
+
+
+@register_layer("rnn_output")
+@dataclass
+class RnnOutputLayer(BaseRecurrent):
+    """Time-distributed output layer (RnnOutputLayer.java): dense+loss applied
+    at every timestep of [batch, time, feat]."""
+
+    activation: Any = "softmax"
+    loss: Any = "mcxent"
+    has_bias: bool = True
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        n_in = self.n_in if self.n_in is not None else input_type.size
+        kW, _ = jax.random.split(key)
+        params = {
+            "W": initializers.initialize(self.weight_init, kW, (n_in, self.n_out), n_in, self.n_out, dtype)
+        }
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return params
+
+    def preactivation(self, params, x):
+        y = x @ params["W"]
+        if self.has_bias:
+            y = y + params["b"]
+        return y
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        y = self.activation_fn()(self.preactivation(params, x))
+        if mask is not None:
+            y = y * mask[..., None]
+        return y, state
+
+    def score(self, params, x, labels, mask=None, average=True, weights=None):
+        preact = self.preactivation(params, x)
+        if average:
+            return losses.average_score(self.loss, labels, preact, self.activation, mask, weights)
+        return losses.per_example_scores(self.loss, labels, preact, self.activation, mask, weights)

@@ -1,0 +1,235 @@
+"""Loss functions.
+
+Capability parity with ND4J's ``ILossFunction`` family used by the reference's
+output layers (MCXENT, NEGATIVELOGLIKELIHOOD, MSE, MAE, L1, L2, XENT, HINGE,
+SQUARED_HINGE, KL_DIVERGENCE, POISSON, COSINE_PROXIMITY, MSLE, MAPE, WASSERSTEIN).
+
+Design: each loss is a pure function
+    ``loss(labels, output, mask=None, weights=None) -> per-example scores [batch]``
+where `output` is the POST-activation network output (DL4J convention). A
+separate :func:`compute` entry point takes pre-activation values and fuses the
+numerically-unstable pairs (softmax+MCXENT -> log_softmax cross-entropy,
+sigmoid+XENT -> logits BCE) so the jitted training step never materialises
+``log(softmax(z))`` — the fused forms are also what XLA pattern-matches best.
+
+Masking follows the reference's per-timestep mask semantics
+(score array is multiplied by the mask and averaged over unmasked entries,
+cf. MaskedReductionUtil in /root/reference/deeplearning4j-nn/.../util/).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-7
+
+LossFn = Callable[..., jax.Array]
+
+_REGISTRY: Dict[str, LossFn] = {}
+
+
+def register(name: str, *aliases: str):
+    def deco(fn: LossFn) -> LossFn:
+        _REGISTRY[name.lower()] = fn
+        for a in aliases:
+            _REGISTRY[a.lower()] = fn
+        return fn
+
+    return deco
+
+
+def get(name_or_fn) -> LossFn:
+    if callable(name_or_fn):
+        return name_or_fn
+    key = str(name_or_fn).lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"Unknown loss '{name_or_fn}'. Known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]
+
+
+def names() -> list:
+    return sorted(_REGISTRY)
+
+
+def _sum_features(x: jax.Array) -> jax.Array:
+    """Sum over all non-batch axes -> per-example score [batch]."""
+    return jnp.sum(x, axis=tuple(range(1, x.ndim)))
+
+
+def _apply_weights(x: jax.Array, weights) -> jax.Array:
+    if weights is None:
+        return x
+    return x * jnp.asarray(weights, x.dtype)
+
+
+@register("mse")
+def mse(labels, output, weights=None):
+    d = _apply_weights((output - labels) ** 2, weights)
+    # DL4J LossMSE divides by the number of output features (vs L2 which doesn't)
+    n = labels.shape[-1] if labels.ndim > 1 else 1
+    return _sum_features(d) / n
+
+
+@register("l2")
+def l2(labels, output, weights=None):
+    return _sum_features(_apply_weights((output - labels) ** 2, weights))
+
+
+@register("mae")
+def mae(labels, output, weights=None):
+    n = labels.shape[-1] if labels.ndim > 1 else 1
+    return _sum_features(_apply_weights(jnp.abs(output - labels), weights)) / n
+
+
+@register("l1")
+def l1(labels, output, weights=None):
+    return _sum_features(_apply_weights(jnp.abs(output - labels), weights))
+
+
+@register("mcxent", "negativeloglikelihood")
+def mcxent(labels, output, weights=None):
+    """Multi-class cross entropy on probabilities: -sum(y * log(p))."""
+    logp = jnp.log(jnp.clip(output, EPS, 1.0))
+    return _sum_features(_apply_weights(-labels * logp, weights))
+
+
+@register("xent")
+def xent(labels, output, weights=None):
+    """Binary cross entropy on probabilities (per-output independent)."""
+    p = jnp.clip(output, EPS, 1.0 - EPS)
+    ce = -(labels * jnp.log(p) + (1.0 - labels) * jnp.log(1.0 - p))
+    return _sum_features(_apply_weights(ce, weights))
+
+
+@register("hinge")
+def hinge(labels, output, weights=None):
+    # labels in {-1, +1} (DL4J converts 0/1 -> -1/+1); here expect ±1.
+    return _sum_features(_apply_weights(jnp.maximum(0.0, 1.0 - labels * output), weights))
+
+
+@register("squared_hinge", "squaredhinge")
+def squared_hinge(labels, output, weights=None):
+    h = jnp.maximum(0.0, 1.0 - labels * output)
+    return _sum_features(_apply_weights(h * h, weights))
+
+
+@register("kl_divergence", "kld", "reconstruction_crossentropy")
+def kld(labels, output, weights=None):
+    y = jnp.clip(labels, EPS, 1.0)
+    p = jnp.clip(output, EPS, 1.0)
+    return _sum_features(_apply_weights(y * (jnp.log(y) - jnp.log(p)), weights))
+
+
+@register("poisson")
+def poisson(labels, output, weights=None):
+    p = jnp.clip(output, EPS, None)
+    return _sum_features(_apply_weights(p - labels * jnp.log(p), weights))
+
+
+@register("cosine_proximity")
+def cosine_proximity(labels, output, weights=None):
+    yn = labels / jnp.maximum(jnp.linalg.norm(labels, axis=-1, keepdims=True), EPS)
+    pn = output / jnp.maximum(jnp.linalg.norm(output, axis=-1, keepdims=True), EPS)
+    return _sum_features(_apply_weights(-yn * pn, weights))
+
+
+@register("msle")
+def msle(labels, output, weights=None):
+    d = jnp.log1p(jnp.clip(output, -1 + EPS, None)) - jnp.log1p(jnp.clip(labels, -1 + EPS, None))
+    n = labels.shape[-1] if labels.ndim > 1 else 1
+    return _sum_features(_apply_weights(d * d, weights)) / n
+
+
+@register("mape")
+def mape(labels, output, weights=None):
+    d = jnp.abs((labels - output) / jnp.clip(jnp.abs(labels), EPS, None)) * 100.0
+    n = labels.shape[-1] if labels.ndim > 1 else 1
+    return _sum_features(_apply_weights(d, weights)) / n
+
+
+@register("wasserstein")
+def wasserstein(labels, output, weights=None):
+    return _sum_features(_apply_weights(labels * output, weights))
+
+
+# ---------------------------------------------------------------------------
+# Fused, numerically-stable entry point used by output layers.
+# ---------------------------------------------------------------------------
+
+
+def per_example_scores(
+    loss,
+    labels: jax.Array,
+    preact: jax.Array,
+    activation: str = "identity",
+    mask: Optional[jax.Array] = None,
+    weights=None,
+) -> jax.Array:
+    """Per-example loss scores from PRE-activation output.
+
+    Fuses (softmax, mcxent) and (sigmoid, xent) into stable logit-space forms;
+    otherwise applies the activation then the probability-space loss.
+
+    For rank-3 time-series inputs [batch, time, feat], a 2-D mask
+    [batch, time] zeroes masked timesteps BEFORE summation, matching the
+    reference's masked scoring.
+    """
+    from deeplearning4j_tpu.nn import activations as _act
+
+    loss_name = loss if isinstance(loss, str) else None
+    if loss_name is not None:
+        loss_name = loss_name.lower()
+
+    if loss_name in ("mcxent", "negativeloglikelihood") and str(activation).lower() == "softmax":
+        logp = jax.nn.log_softmax(preact, axis=-1)
+        elem = -labels * logp
+        if weights is not None:
+            elem = elem * jnp.asarray(weights, elem.dtype)
+    elif loss_name == "xent" and str(activation).lower() == "sigmoid":
+        # stable BCE with logits: max(z,0) - z*y + log(1+exp(-|z|))
+        z = preact
+        elem = jnp.maximum(z, 0.0) - z * labels + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if weights is not None:
+            elem = elem * jnp.asarray(weights, elem.dtype)
+    else:
+        out = _act.get(activation)(preact)
+        fn = get(loss)
+        if mask is not None and preact.ndim == 3 and mask.ndim == 2:
+            # Per-timestep scores, masked before summing over time.
+            elem_scores = fn(
+                labels.reshape(-1, labels.shape[-1]),
+                out.reshape(-1, out.shape[-1]),
+                weights=weights,
+            ).reshape(mask.shape)
+            return jnp.sum(elem_scores * mask, axis=-1)
+        per_ex = fn(labels, out, weights=weights)
+        if mask is not None:
+            per_ex = per_ex * mask.reshape(per_ex.shape)
+        return per_ex
+
+    if elem.ndim == 3 and mask is not None and mask.ndim == 2:
+        return jnp.sum(jnp.sum(elem, axis=-1) * mask, axis=-1)
+    per_ex = _sum_features(elem)
+    if mask is not None:
+        per_ex = per_ex * mask.reshape(per_ex.shape)
+    return per_ex
+
+
+def average_score(
+    loss,
+    labels: jax.Array,
+    preact: jax.Array,
+    activation: str = "identity",
+    mask: Optional[jax.Array] = None,
+    weights=None,
+) -> jax.Array:
+    """Mean loss over examples (over unmasked timesteps for rank-3 + mask),
+    matching the reference's score averaging in BaseOutputLayer.computeScore."""
+    scores = per_example_scores(loss, labels, preact, activation, mask, weights)
+    if mask is not None and labels.ndim == 3 and mask.ndim == 2:
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+        return jnp.sum(scores) / denom
+    return jnp.mean(scores)

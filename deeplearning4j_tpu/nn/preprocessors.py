@@ -1,0 +1,168 @@
+"""Input preprocessors: shape adapters auto-inserted between layer kinds.
+
+Reference parity: nn/conf/preprocessor/{CnnToFeedForwardPreProcessor,
+FeedForwardToCnnPreProcessor,FeedForwardToRnnPreProcessor,
+RnnToFeedForwardPreProcessor,CnnToRnnPreProcessor,RnnToCnnPreProcessor}.java.
+
+Implemented as param-free layers so they flow through the same registry /
+serde / apply machinery. Because this framework's Dense natively handles
+[batch, time, feat], the FF<->RNN reshape pair is only needed when the user
+explicitly wants flattened time-steps.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.config import LayerConfig, register_layer
+from deeplearning4j_tpu.nn.input_type import InputType
+
+
+@register_layer("pp_cnn_to_ff")
+@dataclass
+class CnnToFeedForward(LayerConfig):
+    """[b,h,w,c] -> [b, h*w*c]."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(input_type.height * input_type.width * input_type.channels)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return x.reshape(x.shape[0], -1), state
+
+
+@register_layer("pp_ff_to_cnn")
+@dataclass
+class FeedForwardToCnn(LayerConfig):
+    """[b, h*w*c] -> [b,h,w,c] (also serves conv_flat -> conv)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return x.reshape(x.shape[0], self.height, self.width, self.channels), state
+
+
+@register_layer("pp_rnn_to_ff")
+@dataclass
+class RnnToFeedForward(LayerConfig):
+    """[b,t,f] -> [b*t, f] (time-step flattening as in
+    RnnToFeedForwardPreProcessor; batch axis grows by t)."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(input_type.size)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return x.reshape(-1, x.shape[-1]), state
+
+    def propagate_mask(self, mask, input_type):
+        return mask.reshape(-1) if mask is not None else None
+
+
+@register_layer("pp_ff_to_rnn")
+@dataclass
+class FeedForwardToRnn(LayerConfig):
+    """[b*t, f] -> [b,t,f]; needs static timesteps."""
+
+    timesteps: int = 1
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(input_type.size, self.timesteps)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return x.reshape(-1, self.timesteps, x.shape[-1]), state
+
+    def propagate_mask(self, mask, input_type):
+        return mask.reshape(-1, self.timesteps) if mask is not None else None
+
+
+@register_layer("pp_cnn_to_rnn")
+@dataclass
+class CnnToRnn(LayerConfig):
+    """[b,h,w,c] -> [b, h, w*c] treating height as time
+    (CnnToRnnPreProcessor flattens channels*width per row)."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(input_type.width * input_type.channels, input_type.height)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        b, h, w, c = x.shape
+        return x.reshape(b, h, w * c), state
+
+
+@register_layer("pp_rnn_to_cnn")
+@dataclass
+class RnnToCnn(LayerConfig):
+    """[b,t,f] -> [b,h,w,c] per timestep folded into height."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return x.reshape(x.shape[0], self.height, self.width, self.channels), state
+
+
+@register_layer("reshape")
+@dataclass
+class Reshape(LayerConfig):
+    """Generic reshape (ReshapeVertex equivalent); shape excludes batch."""
+
+    shape: tuple = ()
+
+    def output_type(self, input_type: InputType) -> InputType:
+        s = tuple(self.shape)
+        if len(s) == 1:
+            return InputType.feed_forward(s[0])
+        if len(s) == 2:
+            return InputType.recurrent(s[1], s[0])
+        if len(s) == 3:
+            return InputType.convolutional(s[0], s[1], s[2])
+        raise ValueError(f"Unsupported reshape target {s}")
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return x.reshape((x.shape[0],) + tuple(self.shape)), state
+
+
+def infer_preprocessor(from_type: InputType, to_layer) -> Optional[LayerConfig]:
+    """Auto-insert a shape adapter, mirroring the reference's
+    ``setInputType``/preprocessor inference. Returns None if shapes already
+    line up."""
+    from deeplearning4j_tpu.nn.layers.convolution import (
+        Conv1D,
+        Conv2D,
+        Subsampling1D,
+        Subsampling2D,
+        Upsampling2D,
+        ZeroPadding2D,
+    )
+    from deeplearning4j_tpu.nn.layers.normalization import BatchNorm, LocalResponseNormalization
+    from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrent, Bidirectional, LastTimeStep, MaskZero
+
+    conv_layers = (Conv2D, Subsampling2D, Upsampling2D, ZeroPadding2D, LocalResponseNormalization)
+    rnn_layers = (BaseRecurrent, Bidirectional, LastTimeStep, MaskZero, Conv1D, Subsampling1D)
+
+    if isinstance(to_layer, conv_layers) and from_type.kind == "conv_flat":
+        return FeedForwardToCnn(height=from_type.height, width=from_type.width, channels=from_type.channels)
+    if isinstance(to_layer, conv_layers) and from_type.kind == "ff":
+        raise ValueError(
+            "Feed-forward input into a convolutional layer: specify "
+            "InputType.convolutional_flat(...) so the reshape target is known"
+        )
+    if from_type.kind == "conv" and not isinstance(to_layer, conv_layers):
+        if isinstance(to_layer, rnn_layers):
+            return CnnToRnn()
+        return CnnToFeedForward()
+    if from_type.kind == "conv_flat" and not isinstance(to_layer, conv_layers):
+        # Dense etc. consume the flat vector directly.
+        return None
+    return None

@@ -1,0 +1,46 @@
+"""Test environment: 8 virtual CPU devices so multi-chip sharding semantics
+are testable single-process (SURVEY.md §4 'Lesson' item 4).
+
+Tests must never touch the real TPU: the axon tunnel is a single-process
+grant and a concurrent holder (or a recently killed one) would block
+``jax.devices()`` indefinitely. Besides forcing JAX_PLATFORMS=cpu we
+unregister the axon PJRT plugin factory before any backend initialization —
+the plugin is registered by a sitecustomize hook in every interpreter and
+would otherwise still be dialed during device discovery.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (import after env vars so they take effect)
+
+# The sitecustomize hook imports jax before this file runs, so the
+# JAX_PLATFORMS=axon env default is already captured in jax's config —
+# override it at the config level, then drop the axon plugin factory so
+# device discovery cannot dial the tunnel either.
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge as _xb
+
+    _factories = getattr(_xb, "_backend_factories", None)
+    if isinstance(_factories, dict):
+        _factories.pop("axon", None)
+except Exception:  # pragma: no cover - defensive; tests still pass without it
+    pass
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(12345)
+
+
+@pytest.fixture
+def key():
+    return jax.random.PRNGKey(12345)

@@ -1,0 +1,320 @@
+"""Stage-1 substrate tests: activations, initializers, losses, input types,
+layer config serde. Mirrors the reference's conf/serde unit-test style
+(deeplearning4j-core/src/test/.../nn/conf/, SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import activations, initializers, losses
+from deeplearning4j_tpu.nn.config import LayerConfig, layer_from_dict
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers import (
+    BatchNorm,
+    Conv2D,
+    Dense,
+    Embedding,
+    GlobalPooling,
+    GravesLSTM,
+    LSTM,
+    OutputLayer,
+    SimpleRnn,
+    Subsampling2D,
+)
+
+
+class TestActivations:
+    def test_known_names(self):
+        for name in ["relu", "tanh", "sigmoid", "softmax", "identity", "leakyrelu", "elu"]:
+            fn = activations.get(name)
+            out = fn(jnp.array([-1.0, 0.0, 1.0]))
+            assert out.shape == (3,)
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError):
+            activations.get("nope")
+
+    def test_softmax_normalizes(self):
+        out = activations.get("softmax")(jnp.array([[1.0, 2.0, 3.0]]))
+        np.testing.assert_allclose(np.sum(np.asarray(out)), 1.0, rtol=1e-6)
+
+    def test_hardsigmoid_clips(self):
+        out = activations.get("hardsigmoid")(jnp.array([-10.0, 0.0, 10.0]))
+        np.testing.assert_allclose(np.asarray(out), [0.0, 0.5, 1.0])
+
+
+class TestInitializers:
+    def test_xavier_stats(self, key):
+        w = initializers.initialize("xavier", key, (200, 300), 200, 300)
+        std = float(jnp.std(w))
+        expected = (2.0 / 500) ** 0.5
+        assert abs(std - expected) / expected < 0.1
+
+    def test_relu_stats(self, key):
+        w = initializers.initialize("relu", key, (500, 100), 500, 100)
+        expected = (2.0 / 500) ** 0.5
+        assert abs(float(jnp.std(w)) - expected) / expected < 0.1
+
+    def test_zero_ones(self, key):
+        assert float(jnp.sum(initializers.initialize("zero", key, (3, 3), 3, 3))) == 0.0
+        assert float(jnp.sum(initializers.initialize("ones", key, (3, 3), 3, 3))) == 9.0
+
+    def test_distribution(self, key):
+        d = initializers.Distribution(kind="uniform", lower=2.0, upper=3.0)
+        w = initializers.initialize(d, key, (100,), 1, 1)
+        assert float(jnp.min(w)) >= 2.0 and float(jnp.max(w)) <= 3.0
+
+    def test_identity(self, key):
+        w = initializers.initialize("identity", key, (4, 4), 4, 4)
+        np.testing.assert_allclose(np.asarray(w), np.eye(4))
+
+
+class TestLosses:
+    def test_mse_matches_numpy(self):
+        y = jnp.array([[1.0, 2.0], [3.0, 4.0]])
+        p = jnp.array([[1.5, 2.0], [2.0, 4.0]])
+        out = losses.get("mse")(y, p)
+        np.testing.assert_allclose(np.asarray(out), [0.125, 0.5], rtol=1e-6)
+
+    def test_mcxent_perfect_prediction_near_zero(self):
+        y = jnp.array([[0.0, 1.0]])
+        p = jnp.array([[0.0, 1.0]])
+        out = losses.get("mcxent")(y, p)
+        assert float(out[0]) < 1e-5
+
+    def test_fused_softmax_mcxent_matches_unfused(self):
+        z = jnp.array([[2.0, -1.0, 0.5], [0.0, 1.0, -2.0]])
+        y = jnp.array([[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]])
+        fused = losses.per_example_scores("mcxent", y, z, "softmax")
+        unfused = losses.get("mcxent")(y, jax.nn.softmax(z, axis=-1))
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(unfused), rtol=1e-3)
+
+    def test_fused_sigmoid_xent_matches_unfused(self):
+        z = jnp.array([[2.0, -3.0], [0.5, 1.0]])
+        y = jnp.array([[1.0, 0.0], [0.0, 1.0]])
+        fused = losses.per_example_scores("xent", y, z, "sigmoid")
+        unfused = losses.get("xent")(y, jax.nn.sigmoid(z))
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(unfused), rtol=1e-3)
+
+    def test_masked_timeseries_score(self):
+        z = jnp.zeros((2, 3, 4))  # uniform logits
+        y = jax.nn.one_hot(jnp.zeros((2, 3), jnp.int32), 4)
+        mask = jnp.array([[1.0, 1.0, 0.0], [1.0, 0.0, 0.0]])
+        avg = losses.average_score("mcxent", y, z, "softmax", mask)
+        np.testing.assert_allclose(float(avg), np.log(4.0), rtol=1e-5)
+
+
+class TestInputType:
+    def test_roundtrip(self):
+        for t in [
+            InputType.feed_forward(10),
+            InputType.recurrent(5, 7),
+            InputType.convolutional(28, 28, 3),
+            InputType.convolutional_flat(28, 28, 1),
+        ]:
+            assert InputType.from_dict(t.to_dict()) == t
+
+    def test_conv_flat_size(self):
+        assert InputType.convolutional_flat(28, 28, 1).flat_size() == 784
+
+
+class TestLayerSerde:
+    def test_dense_roundtrip(self):
+        cfg = Dense(n_in=10, n_out=20, activation="relu", l2=1e-4, name="d0")
+        restored = LayerConfig.from_json(cfg.to_json())
+        assert restored == cfg
+
+    def test_conv_roundtrip(self):
+        cfg = Conv2D(n_out=32, kernel=(5, 5), stride=(2, 2), convolution_mode="same")
+        restored = LayerConfig.from_json(cfg.to_json())
+        assert isinstance(restored, Conv2D)
+        assert tuple(restored.kernel) == (5, 5)
+
+    def test_output_layer_roundtrip(self):
+        cfg = OutputLayer(n_out=10, activation="softmax", loss="mcxent")
+        restored = LayerConfig.from_json(cfg.to_json())
+        assert restored.loss == "mcxent"
+
+    def test_nested_rnn_wrapper_roundtrip(self):
+        from deeplearning4j_tpu.nn.layers import Bidirectional, LastTimeStep
+
+        cfg = LastTimeStep(rnn=LSTM(n_in=8, n_out=16))
+        restored = LayerConfig.from_json(cfg.to_json())
+        assert isinstance(restored.rnn, LSTM)
+        assert restored.rnn.n_out == 16
+
+    def test_unknown_field_ignored(self):
+        d = Dense(n_in=3, n_out=4).to_dict()
+        d["some_future_field"] = 42
+        restored = layer_from_dict(d)
+        assert restored.n_out == 4
+
+    def test_unknown_type_raises(self):
+        with pytest.raises(ValueError):
+            layer_from_dict({"@type": "not_a_layer"})
+
+
+class TestLayerForward:
+    def test_dense_shapes(self, key):
+        cfg = Dense(n_in=8, n_out=4, activation="relu")
+        params = cfg.init(key, InputType.feed_forward(8))
+        y, _ = cfg.apply(params, {}, jnp.ones((2, 8)))
+        assert y.shape == (2, 4)
+        assert params["W"].shape == (8, 4)
+
+    def test_dense_rank3(self, key):
+        cfg = Dense(n_in=8, n_out=4)
+        params = cfg.init(key, InputType.feed_forward(8))
+        y, _ = cfg.apply(params, {}, jnp.ones((2, 5, 8)))
+        assert y.shape == (2, 5, 4)
+
+    def test_conv_same_shapes(self, key):
+        cfg = Conv2D(n_out=16, kernel=(3, 3), convolution_mode="same")
+        it = InputType.convolutional(8, 8, 3)
+        params = cfg.init(key, it)
+        y, _ = cfg.apply(params, {}, jnp.ones((2, 8, 8, 3)))
+        assert y.shape == (2, 8, 8, 16)
+        assert cfg.output_type(it) == InputType.convolutional(8, 8, 16)
+
+    def test_conv_truncate_shapes(self, key):
+        cfg = Conv2D(n_out=6, kernel=(5, 5), stride=(1, 1), convolution_mode="truncate")
+        it = InputType.convolutional(28, 28, 1)
+        params = cfg.init(key, it)
+        y, _ = cfg.apply(params, {}, jnp.ones((2, 28, 28, 1)))
+        assert y.shape == (2, 24, 24, 6)
+        assert cfg.output_type(it).height == 24
+
+    def test_subsampling(self, key):
+        cfg = Subsampling2D(kernel=(2, 2), stride=(2, 2), pooling="max")
+        y, _ = cfg.apply({}, {}, jnp.arange(16.0).reshape(1, 4, 4, 1))
+        assert y.shape == (1, 2, 2, 1)
+        np.testing.assert_allclose(np.asarray(y)[0, :, :, 0], [[5.0, 7.0], [13.0, 15.0]])
+
+    def test_batchnorm_train_normalizes(self, key):
+        cfg = BatchNorm()
+        it = InputType.feed_forward(4)
+        params = cfg.init(key, it)
+        state = cfg.init_state(it)
+        x = jax.random.normal(key, (64, 4)) * 5.0 + 3.0
+        y, new_state = cfg.apply(params, state, x, train=True)
+        np.testing.assert_allclose(np.asarray(jnp.mean(y, axis=0)), np.zeros(4), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(jnp.std(y, axis=0)), np.ones(4), atol=1e-2)
+        assert not np.allclose(np.asarray(new_state["mean"]), 0.0)
+
+    def test_lstm_shapes_and_carry(self, key):
+        cfg = LSTM(n_in=6, n_out=10)
+        params = cfg.init(key, InputType.recurrent(6))
+        x = jnp.ones((3, 7, 6))
+        y, _ = cfg.apply(params, {}, x)
+        assert y.shape == (3, 7, 10)
+        carry = cfg.initial_carry(3)
+        y2, (h, c) = cfg.apply_seq(params, x, carry)
+        assert h.shape == (3, 10) and c.shape == (3, 10)
+        np.testing.assert_allclose(np.asarray(y2[:, -1, :]), np.asarray(h), rtol=1e-6)
+
+    def test_lstm_forget_bias(self, key):
+        cfg = LSTM(n_in=4, n_out=3, forget_gate_bias_init=1.0)
+        params = cfg.init(key, InputType.recurrent(4))
+        b = np.asarray(params["b"])
+        np.testing.assert_allclose(b[3:6], 1.0)
+        np.testing.assert_allclose(b[:3], 0.0)
+
+    def test_lstm_masking_freezes_state(self, key):
+        cfg = LSTM(n_in=4, n_out=3)
+        params = cfg.init(key, InputType.recurrent(4))
+        x = jax.random.normal(key, (2, 5, 4))
+        mask = jnp.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], jnp.float32)
+        y, (h, c) = cfg.apply_seq(params, x, cfg.initial_carry(2), mask)
+        # masked outputs are zero
+        np.testing.assert_allclose(np.asarray(y[0, 3:]), 0.0)
+        # final state of row 0 equals state after 3 valid steps
+        y3, (h3, c3) = cfg.apply_seq(params, x[:, :3], cfg.initial_carry(2))
+        np.testing.assert_allclose(np.asarray(h[0]), np.asarray(h3[0]), rtol=1e-5)
+
+    def test_graves_lstm_has_peepholes(self, key):
+        cfg = GravesLSTM(n_in=4, n_out=3)
+        params = cfg.init(key, InputType.recurrent(4))
+        assert params["peephole"].shape == (9,)
+        y, _ = cfg.apply(params, {}, jnp.ones((2, 5, 4)))
+        assert y.shape == (2, 5, 3)
+
+    def test_simple_rnn(self, key):
+        cfg = SimpleRnn(n_in=4, n_out=3)
+        params = cfg.init(key, InputType.recurrent(4))
+        y, _ = cfg.apply(params, {}, jnp.ones((2, 5, 4)))
+        assert y.shape == (2, 5, 3)
+
+    def test_embedding(self, key):
+        cfg = Embedding(n_in=50, n_out=8)
+        params = cfg.init(key, InputType.feed_forward(50))
+        y, _ = cfg.apply(params, {}, jnp.array([3, 7]))
+        assert y.shape == (2, 8)
+        np.testing.assert_allclose(np.asarray(y[0]), np.asarray(params["W"][3]))
+
+    def test_global_pooling_masked(self, key):
+        cfg = GlobalPooling(pooling="avg")
+        x = jnp.ones((2, 4, 3))
+        mask = jnp.array([[1, 1, 0, 0], [1, 1, 1, 1]], jnp.float32)
+        y, _ = cfg.apply({}, {}, x, mask=mask)
+        np.testing.assert_allclose(np.asarray(y), 1.0)
+
+    def test_dropout_train_vs_infer(self, key):
+        cfg = Dense(n_in=10, n_out=10, dropout=0.5)
+        params = cfg.init(key, InputType.feed_forward(10))
+        x = jnp.ones((4, 10))
+        y_inf, _ = cfg.apply(params, {}, x, train=False)
+        y_tr, _ = cfg.apply(params, {}, x, train=True, rng=jax.random.PRNGKey(1))
+        assert not np.allclose(np.asarray(y_inf), np.asarray(y_tr))
+
+
+class TestReviewRegressions:
+    """Fixes from the first code review: deconv shape contract, dilation in
+    shape inference, Subsampling1D pooling modes, nested-params l1/l2,
+    Bidirectional dropout."""
+
+    def test_deconv_shape_matches_output_type(self, key):
+        from deeplearning4j_tpu.nn.layers import Deconv2D
+
+        cfg = Deconv2D(n_out=2, kernel=(3, 3), stride=(2, 2), convolution_mode="truncate")
+        it = InputType.convolutional(4, 4, 1)
+        params = cfg.init(key, it)
+        y, _ = cfg.apply(params, {}, jnp.ones((1, 4, 4, 1)))
+        ot = cfg.output_type(it)
+        assert y.shape == (1, ot.height, ot.width, 2)
+        assert ot.height == 2 * 3 + 3 - 0  # s*(h-1)+k-2p = 9
+
+    def test_conv_dilation_shape_inference(self, key):
+        cfg = Conv2D(n_out=8, kernel=(3, 3), dilation=(2, 2), convolution_mode="truncate")
+        it = InputType.convolutional(8, 8, 1)
+        params = cfg.init(key, it)
+        y, _ = cfg.apply(params, {}, jnp.ones((1, 8, 8, 1)))
+        ot = cfg.output_type(it)
+        assert y.shape[1:3] == (ot.height, ot.width) == (4, 4)
+
+    def test_subsampling1d_sum(self, key):
+        from deeplearning4j_tpu.nn.layers import Subsampling1D
+
+        cfg = Subsampling1D(kernel=2, stride=2, pooling="sum")
+        y, _ = cfg.apply({}, {}, jnp.ones((1, 4, 1)))
+        np.testing.assert_allclose(np.asarray(y), 2.0)
+        with pytest.raises(ValueError):
+            Subsampling1D(pooling="bogus").apply({}, {}, jnp.ones((1, 4, 1)))
+
+    def test_regularization_nested_params(self, key):
+        from deeplearning4j_tpu.nn.layers import Bidirectional
+
+        cfg = Bidirectional(rnn=LSTM(n_in=3, n_out=4), l2=1e-2)
+        params = cfg.init(key, InputType.recurrent(3))
+        pen = cfg.regularization_penalty(params)
+        assert float(pen) > 0.0
+
+    def test_bidirectional_dropout_applies(self, key):
+        from deeplearning4j_tpu.nn.layers import Bidirectional
+
+        cfg = Bidirectional(rnn=LSTM(n_in=4, n_out=3), dropout=0.5)
+        params = cfg.init(key, InputType.recurrent(4))
+        x = jnp.ones((2, 5, 4))
+        y_inf, _ = cfg.apply(params, {}, x, train=False)
+        y_tr, _ = cfg.apply(params, {}, x, train=True, rng=jax.random.PRNGKey(7))
+        assert not np.allclose(np.asarray(y_inf), np.asarray(y_tr))
