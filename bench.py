@@ -1,0 +1,66 @@
+"""Benchmark: LeNet-5 MNIST-shape training throughput (BASELINE config #1).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md): vs_baseline is measured
+against a fixed nominal reference of 10,000 samples/sec — roughly what the
+reference's LeNet-5 sustains on a V100 via nd4j-cuda — so the ratio is
+meaningful across rounds even though the true baseline must be measured.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+NOMINAL_BASELINE_SAMPLES_PER_SEC = 10_000.0
+
+
+def main():
+    import jax
+    from deeplearning4j_tpu.models import LeNet5
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+
+    batch = 256
+    rs = np.random.RandomState(0)
+    x = rs.rand(batch, 28, 28, 1).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rs.randint(0, 10, batch)]
+
+    import jax.numpy as jnp
+
+    model = MultiLayerNetwork(LeNet5(dtype="float32")).init()
+
+    # Drive the raw jitted step (no per-step host sync on the loss — the
+    # listener path would serialize host<->device every iteration).
+    step = model._get_step_fn(False)
+    xd, yd = jnp.asarray(x), jnp.asarray(y)
+    params, opt, state = model.params, model.opt_state, model.state
+    rng = jax.random.PRNGKey(0)
+
+    def run(n, params, opt, state):
+        for i in range(n):
+            params, opt, state, _, loss = step(
+                params, opt, state, jnp.asarray(i, jnp.int32), rng, xd, yd, None, None, ()
+            )
+        jax.block_until_ready(loss)
+        return params, opt, state
+
+    params, opt, state = run(5, params, opt, state)  # warmup/compile
+    steps = 50
+    t0 = time.perf_counter()
+    params, opt, state = run(steps, params, opt, state)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = steps * batch / dt
+    print(json.dumps({
+        "metric": "lenet5_mnist_train_throughput",
+        "value": round(samples_per_sec, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(samples_per_sec / NOMINAL_BASELINE_SAMPLES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

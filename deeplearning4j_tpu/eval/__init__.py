@@ -1,0 +1,28 @@
+"""Evaluation: classification / regression / ROC metrics.
+
+Parity: the reference's eval family (eval/Evaluation.java:72,
+RegressionEvaluation.java, ROC.java, ROCBinary, ROCMultiClass,
+EvaluationBinary, EvaluationCalibration, ConfusionMatrix) — SURVEY.md §2.1.
+
+Accumulation happens on the host in numpy (tiny state: confusion counts,
+histograms); the heavy part (the forward pass producing predictions) runs on
+TPU. Every class supports ``merge`` so evaluations computed per-shard /
+per-host can be combined, the way Spark workers merge Evaluation objects.
+"""
+
+from deeplearning4j_tpu.eval.evaluation import ConfusionMatrix, Evaluation
+from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+from deeplearning4j_tpu.eval.roc import ROC, ROCBinary, ROCMultiClass
+from deeplearning4j_tpu.eval.binary import EvaluationBinary
+from deeplearning4j_tpu.eval.calibration import EvaluationCalibration
+
+__all__ = [
+    "Evaluation",
+    "ConfusionMatrix",
+    "RegressionEvaluation",
+    "ROC",
+    "ROCBinary",
+    "ROCMultiClass",
+    "EvaluationBinary",
+    "EvaluationCalibration",
+]

@@ -1,0 +1,10 @@
+"""Model zoo.
+
+Parity: deeplearning4j-zoo (SURVEY.md §2.8) — standard architectures as
+config builders. Each returns a configuration whose JSON round-trips, so zoo
+models are data, not code.
+"""
+
+from deeplearning4j_tpu.models.zoo import LeNet5, SimpleCNN, TextGenerationLSTM
+
+__all__ = ["LeNet5", "SimpleCNN", "TextGenerationLSTM"]

@@ -1,0 +1,93 @@
+"""Zoo architectures (sequential ones; DAG models land with ComputationGraph).
+
+Parity targets (reference deeplearning4j-zoo/src/main/java/org/deeplearning4j/zoo/model/):
+LeNet.java, SimpleCNN.java, TextGenerationLSTM.java here; AlexNet, VGG16/19,
+ResNet50, GoogLeNet, Darknet19, TinyYOLO, InceptionResNetV1 arrive as
+ComputationGraph configs.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers import (
+    BatchNorm,
+    Conv2D,
+    Dense,
+    DropoutLayer,
+    LSTM,
+    OutputLayer,
+    RnnOutputLayer,
+    Subsampling2D,
+)
+from deeplearning4j_tpu.nn.model import MultiLayerConfiguration
+
+
+def LeNet5(height: int = 28, width: int = 28, channels: int = 1,
+           num_classes: int = 10, updater=None, seed: int = 12345,
+           dtype: str = "float32") -> MultiLayerConfiguration:
+    """LeNet-5 (zoo/model/LeNet.java): conv5x5x20 - pool - conv5x5x50 - pool -
+    dense500 - softmax. BASELINE config #1."""
+    return MultiLayerConfiguration(
+        layers=(
+            Conv2D(n_out=20, kernel=(5, 5), stride=(1, 1), activation="identity",
+                   convolution_mode="same"),
+            Subsampling2D(kernel=(2, 2), stride=(2, 2), pooling="max"),
+            Conv2D(n_out=50, kernel=(5, 5), stride=(1, 1), activation="identity",
+                   convolution_mode="same"),
+            Subsampling2D(kernel=(2, 2), stride=(2, 2), pooling="max"),
+            Dense(n_out=500, activation="relu"),
+            OutputLayer(n_out=num_classes, activation="softmax", loss="mcxent"),
+        ),
+        input_type=InputType.convolutional(height, width, channels),
+        updater=updater or {"type": "adam", "lr": 1e-3},
+        seed=seed,
+        dtype=dtype,
+    )
+
+
+def SimpleCNN(height: int = 48, width: int = 48, channels: int = 3,
+              num_classes: int = 10, updater=None, seed: int = 12345) -> MultiLayerConfiguration:
+    """SimpleCNN.java: small conv stack with BN + dropout."""
+    return MultiLayerConfiguration(
+        layers=(
+            Conv2D(n_out=16, kernel=(3, 3), activation="relu", convolution_mode="same"),
+            BatchNorm(),
+            Conv2D(n_out=16, kernel=(3, 3), activation="relu", convolution_mode="same"),
+            BatchNorm(),
+            Subsampling2D(kernel=(2, 2), stride=(2, 2)),
+            Conv2D(n_out=32, kernel=(3, 3), activation="relu", convolution_mode="same"),
+            BatchNorm(),
+            Conv2D(n_out=32, kernel=(3, 3), activation="relu", convolution_mode="same"),
+            BatchNorm(),
+            Subsampling2D(kernel=(2, 2), stride=(2, 2)),
+            DropoutLayer(dropout=0.5),
+            Dense(n_out=256, activation="relu"),
+            OutputLayer(n_out=num_classes, activation="softmax"),
+        ),
+        input_type=InputType.convolutional(height, width, channels),
+        updater=updater or {"type": "adam", "lr": 1e-3},
+        seed=seed,
+    )
+
+
+def TextGenerationLSTM(vocab_size: int = 77, timesteps: int = 50,
+                       hidden: int = 256, updater=None, seed: int = 12345,
+                       dtype: str = "float32") -> MultiLayerConfiguration:
+    """TextGenerationLSTM.java / GravesLSTM char-RNN (BASELINE config #3):
+    2x LSTM(256) + time-distributed softmax, tBPTT."""
+    from deeplearning4j_tpu.nn.layers import GravesLSTM
+
+    return MultiLayerConfiguration(
+        layers=(
+            GravesLSTM(n_out=hidden),
+            GravesLSTM(n_out=hidden),
+            RnnOutputLayer(n_out=vocab_size, activation="softmax", loss="mcxent"),
+        ),
+        input_type=InputType.recurrent(vocab_size, timesteps),
+        updater=updater or {"type": "rmsprop", "lr": 1e-3},
+        seed=seed,
+        backprop_type="tbptt",
+        tbptt_fwd_length=50,
+        tbptt_back_length=50,
+        dtype=dtype,
+    )

@@ -227,3 +227,8 @@ class FeedForwardLayerConfig(LayerConfig):
         if self.n_in is None:
             return dataclasses.replace(self, n_in=int(n_in))
         return self
+
+    def infer_n_in(self, input_type: InputType) -> int:
+        """What this layer's n_in means given an input type (flat features by
+        default; conv layers override to channels)."""
+        return input_type.flat_size()

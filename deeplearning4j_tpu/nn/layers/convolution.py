@@ -68,6 +68,9 @@ class Conv2D(FeedForwardLayerConfig):
     convolution_mode: str = "truncate"  # same | truncate | strict
     has_bias: bool = True
 
+    def infer_n_in(self, input_type):
+        return input_type.channels  # n_in = input channels, not flat size
+
     def output_type(self, input_type: InputType) -> InputType:
         if input_type.kind != "conv":
             raise ValueError(f"Conv2D needs convolutional input, got {input_type}")
@@ -284,6 +287,27 @@ class Conv1D(FeedForwardLayerConfig):
             y = y + params["b"]
         return self.activation_fn()(y), state
 
+    def propagate_mask(self, mask, input_type):
+        return _subsample_mask_1d(
+            mask, int(self.kernel), int(self.stride), int(self.padding),
+            self.convolution_mode, int(self.dilation),
+        )
+
+
+def _subsample_mask_1d(mask, kernel, stride, padding, mode, dilation=1):
+    """Downsample a [batch, T] mask to the pooled/conv output length: keep the
+    mask value at each output window's start position (the reference's
+    stride-based mask reduction for 1-D conv/subsampling layers)."""
+    if mask is None:
+        return None
+    T = mask.shape[1]
+    if mode == "same":
+        ot = -(-T // stride)  # ceil
+    else:
+        ot = _out_size(T, kernel, stride, padding, mode, dilation)
+    idx = jnp.clip(jnp.arange(ot) * stride, 0, T - 1)
+    return jnp.take(mask, idx, axis=1)
+
 
 @register_layer("subsampling2d")
 @dataclass
@@ -372,6 +396,11 @@ class Subsampling1D(LayerConfig):
         else:
             raise ValueError(f"Unknown pooling '{self.pooling}'")
         return y, state
+
+    def propagate_mask(self, mask, input_type):
+        return _subsample_mask_1d(
+            mask, int(self.kernel), int(self.stride), int(self.padding), self.convolution_mode
+        )
 
 
 @register_layer("upsampling2d")

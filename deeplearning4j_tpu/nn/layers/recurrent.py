@@ -43,14 +43,50 @@ def _mask_step(mask_t, new, old):
 class BaseRecurrent(FeedForwardLayerConfig):
     """Common recurrent scaffolding."""
 
+    # True for layers with a time-stepped carry (LSTM/SimpleRnn...): enables
+    # tBPTT chunking and rnnTimeStep streaming through the model.
+    SUPPORTS_CARRY = True
+
     def output_type(self, input_type: InputType) -> InputType:
         return InputType.recurrent(self.n_out, input_type.timesteps)
 
     def initial_carry(self, batch: int, dtype=jnp.float32):
         raise NotImplementedError
 
-    def apply_seq(self, params, x, carry, mask=None):
+    def _cell(self, params, x_t, carry):
+        """One timestep: (params, x_t [b,f], carry) -> new_carry."""
         raise NotImplementedError
+
+    def _carry_output(self, carry):
+        """Extract the per-step output h from the carry."""
+        return carry
+
+    def apply_seq(self, params, x, carry, mask=None):
+        """Shared scan scaffolding: [b,t,f] -> ([b,t,h], final_carry).
+
+        Masked steps pass the carry through unchanged and emit zeros — the
+        single implementation of the reference's masked-RNN semantics, used
+        by every recurrent cell via the ``_cell`` hook."""
+
+        def step(c, inp):
+            x_t, m_t = inp if mask is not None else (inp, None)
+            new_c = self._cell(params, x_t, c)
+            if m_t is not None:
+                new_c = jax.tree_util.tree_map(
+                    lambda n, o: _mask_step(m_t, n, o), new_c, c
+                )
+                out = self._carry_output(new_c) * m_t[:, None]
+            else:
+                out = self._carry_output(new_c)
+            return new_c, out
+
+        xs = jnp.swapaxes(x, 0, 1)  # [time, batch, feat] for scan
+        if mask is not None:
+            ms = jnp.swapaxes(mask.astype(x.dtype), 0, 1)
+            final, outs = lax.scan(step, carry, (xs, ms))
+        else:
+            final, outs = lax.scan(step, carry, xs)
+        return jnp.swapaxes(outs, 0, 1), final
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         x = self.maybe_dropout_input(x, train, rng)
@@ -88,48 +124,24 @@ class LSTM(BaseRecurrent):
         H = self.n_out
         return (jnp.zeros((batch, H), dtype), jnp.zeros((batch, H), dtype))
 
-    def _gates(self, params, x_t, h):
-        z = x_t @ params["Wx"] + h @ params["Wh"] + params["b"]
-        H = self.n_out
+    def _carry_output(self, carry):
+        return carry[0]
+
+    def _cell(self, params, x_t, carry):
         from deeplearning4j_tpu.nn import activations as A
 
+        h, cell = carry
+        H = self.n_out
         gate = A.get(self.gate_activation)
         act = A.get(self.activation)
+        z = x_t @ params["Wx"] + h @ params["Wh"] + params["b"]
         i = gate(z[:, 0 * H : 1 * H])
         f = gate(z[:, 1 * H : 2 * H])
         g = act(z[:, 2 * H : 3 * H])
         o = gate(z[:, 3 * H : 4 * H])
-        return i, f, g, o
-
-    def apply_seq(self, params, x, carry, mask=None):
-        from deeplearning4j_tpu.nn import activations as A
-
-        act = A.get(self.activation)
-
-        def step(c, inp):
-            h, cell = c
-            if mask is None:
-                x_t = inp
-            else:
-                x_t, m_t = inp
-            i, f, g, o = self._gates(params, x_t, h)
-            new_cell = f * cell + i * g
-            new_h = o * act(new_cell)
-            if mask is not None:
-                new_cell = _mask_step(m_t, new_cell, cell)
-                new_h = _mask_step(m_t, new_h, h)
-                out = new_h * m_t[:, None]
-            else:
-                out = new_h
-            return (new_h, new_cell), out
-
-        xs = jnp.swapaxes(x, 0, 1)  # [time, batch, feat] for scan
-        if mask is not None:
-            ms = jnp.swapaxes(mask.astype(x.dtype), 0, 1)
-            (h, cell), outs = lax.scan(step, carry, (xs, ms))
-        else:
-            (h, cell), outs = lax.scan(step, carry, xs)
-        return jnp.swapaxes(outs, 0, 1), (h, cell)
+        new_cell = f * cell + i * g
+        new_h = o * act(new_cell)
+        return (new_h, new_cell)
 
 
 @register_layer("graves_lstm")
@@ -144,43 +156,23 @@ class GravesLSTM(LSTM):
         params["peephole"] = jnp.zeros((3 * H,), dtype)  # [p_i, p_f, p_o]
         return params
 
-    def apply_seq(self, params, x, carry, mask=None):
+    def _cell(self, params, x_t, carry):
         from deeplearning4j_tpu.nn import activations as A
 
+        h, cell = carry
+        H = self.n_out
         act = A.get(self.activation)
         gate = A.get(self.gate_activation)
-        H = self.n_out
         p = params["peephole"]
         p_i, p_f, p_o = p[:H], p[H : 2 * H], p[2 * H :]
-
-        def step(c, inp):
-            h, cell = c
-            if mask is None:
-                x_t = inp
-            else:
-                x_t, m_t = inp
-            z = x_t @ params["Wx"] + h @ params["Wh"] + params["b"]
-            i = gate(z[:, 0 * H : 1 * H] + cell * p_i)
-            f = gate(z[:, 1 * H : 2 * H] + cell * p_f)
-            g = act(z[:, 2 * H : 3 * H])
-            new_cell = f * cell + i * g
-            o = gate(z[:, 3 * H : 4 * H] + new_cell * p_o)
-            new_h = o * act(new_cell)
-            if mask is not None:
-                new_cell = _mask_step(m_t, new_cell, cell)
-                new_h = _mask_step(m_t, new_h, h)
-                out = new_h * m_t[:, None]
-            else:
-                out = new_h
-            return (new_h, new_cell), out
-
-        xs = jnp.swapaxes(x, 0, 1)
-        if mask is not None:
-            ms = jnp.swapaxes(mask.astype(x.dtype), 0, 1)
-            (h, cell), outs = lax.scan(step, carry, (xs, ms))
-        else:
-            (h, cell), outs = lax.scan(step, carry, xs)
-        return jnp.swapaxes(outs, 0, 1), (h, cell)
+        z = x_t @ params["Wx"] + h @ params["Wh"] + params["b"]
+        i = gate(z[:, 0 * H : 1 * H] + cell * p_i)
+        f = gate(z[:, 1 * H : 2 * H] + cell * p_f)
+        g = act(z[:, 2 * H : 3 * H])
+        new_cell = f * cell + i * g
+        o = gate(z[:, 3 * H : 4 * H] + new_cell * p_o)
+        new_h = o * act(new_cell)
+        return (new_h, new_cell)
 
 
 @register_layer("simple_rnn")
@@ -203,29 +195,8 @@ class SimpleRnn(BaseRecurrent):
     def initial_carry(self, batch: int, dtype=jnp.float32):
         return jnp.zeros((batch, self.n_out), dtype)
 
-    def apply_seq(self, params, x, carry, mask=None):
-        act = self.activation_fn()
-
-        def step(h, inp):
-            if mask is None:
-                x_t = inp
-            else:
-                x_t, m_t = inp
-            new_h = act(x_t @ params["Wx"] + h @ params["Wh"] + params["b"])
-            if mask is not None:
-                new_h = _mask_step(m_t, new_h, h)
-                out = new_h * m_t[:, None]
-            else:
-                out = new_h
-            return new_h, out
-
-        xs = jnp.swapaxes(x, 0, 1)
-        if mask is not None:
-            ms = jnp.swapaxes(mask.astype(x.dtype), 0, 1)
-            h, outs = lax.scan(step, carry, (xs, ms))
-        else:
-            h, outs = lax.scan(step, carry, xs)
-        return jnp.swapaxes(outs, 0, 1), h
+    def _cell(self, params, x_t, carry):
+        return self.activation_fn()(x_t @ params["Wx"] + carry @ params["Wh"] + params["b"])
 
 
 @register_layer("bidirectional")
@@ -251,12 +222,22 @@ class Bidirectional(LayerConfig):
             "bwd": self.rnn.init(kb, input_type, dtype),
         }
 
+    def regularization_penalty(self, params):
+        pen = super().regularization_penalty(params)
+        return pen + self.rnn.regularization_penalty(params["fwd"]) + \
+            self.rnn.regularization_penalty(params["bwd"])
+
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         # Input dropout: honor both the wrapper's and the wrapped RNN's
-        # configured dropout (apply_seq bypasses BaseRecurrent.apply).
+        # configured dropout (apply_seq bypasses BaseRecurrent.apply) with
+        # independent rng streams.
+        if rng is not None:
+            rng, rng2 = jax.random.split(rng)
+        else:
+            rng2 = None
         x = self.maybe_dropout_input(x, train, rng)
         if train and self.rnn.dropout > 0.0:
-            x = self.rnn.maybe_dropout_input(x, train, rng)
+            x = self.rnn.maybe_dropout_input(x, train, rng2)
         carry_f = self.rnn.initial_carry(x.shape[0], x.dtype)
         carry_b = self.rnn.initial_carry(x.shape[0], x.dtype)
         yf, _ = self.rnn.apply_seq(params["fwd"], x, carry_f, mask)
@@ -290,12 +271,19 @@ class LastTimeStep(LayerConfig):
     def init(self, key, input_type, dtype=jnp.float32):
         return self.rnn.init(key, input_type, dtype)
 
+    def regularization_penalty(self, params):
+        return super().regularization_penalty(params) + self.rnn.regularization_penalty(params)
+
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         y, _ = self.rnn.apply(params, {}, x, train=train, rng=rng, mask=mask)
         if mask is None:
             out = y[:, -1, :]
         else:
-            idx = jnp.maximum(jnp.sum(mask > 0, axis=1).astype(jnp.int32) - 1, 0)
+            # last index where mask==1 (handles left-padded/ALIGN_END masks,
+            # not just contiguous-from-t0)
+            T = y.shape[1]
+            rev = jnp.flip(mask > 0, axis=1)
+            idx = (T - 1 - jnp.argmax(rev, axis=1)).astype(jnp.int32)
             out = jnp.take_along_axis(y, idx[:, None, None], axis=1)[:, 0, :]
         return out, state
 
@@ -318,6 +306,9 @@ class MaskZero(LayerConfig):
     def init(self, key, input_type, dtype=jnp.float32):
         return self.rnn.init(key, input_type, dtype)
 
+    def regularization_penalty(self, params):
+        return super().regularization_penalty(params) + self.rnn.regularization_penalty(params)
+
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         derived = jnp.any(x != self.mask_value, axis=-1).astype(x.dtype)
         if mask is not None:
@@ -330,6 +321,8 @@ class MaskZero(LayerConfig):
 class RnnOutputLayer(BaseRecurrent):
     """Time-distributed output layer (RnnOutputLayer.java): dense+loss applied
     at every timestep of [batch, time, feat]."""
+
+    SUPPORTS_CARRY = False  # no recurrence of its own
 
     activation: Any = "softmax"
     loss: Any = "mcxent"

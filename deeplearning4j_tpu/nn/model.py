@@ -1,0 +1,538 @@
+"""Sequential model: MultiLayerConfiguration + MultiLayerNetwork.
+
+Capability parity with the reference's
+nn/multilayer/MultiLayerNetwork.java (3,538 LoC: init:548, feedForward:878,
+fit:1261, output:2005, computeGradientAndScore:2353) and
+nn/conf/MultiLayerConfiguration.java — re-designed TPU-first:
+
+- The whole training iteration (forward, loss, autodiff backward, gradient
+  normalization, updater, parameter update) is ONE pure function traced and
+  compiled ONCE by XLA, with params/opt-state donated so updates happen
+  in-place in HBM. The reference instead drives ~1 JNI kernel dispatch per op
+  per layer per iteration (SURVEY.md §3.1).
+- Parameters are a pytree (tuple of per-layer dicts), not a flattened view;
+  optimizer state lives in a parallel pytree (no UpdaterBlocks).
+- Backward comes from jax.grad of the step — the per-layer
+  ``backpropGradient`` methods of the reference do not exist.
+- Truncated BPTT (MultiLayerNetwork.doTruncatedBPTT:1514) is scan-over-chunks
+  with carried RNN state; ``rnn_time_step`` keeps carries on device between
+  calls (rnnTimeStep:2371 equivalents).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.config import LayerConfig, layer_from_dict, _encode_value
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrent
+from deeplearning4j_tpu.nn.preprocessors import infer_preprocessor
+from deeplearning4j_tpu.train.updaters import (
+    apply_gradient_normalization,
+    make_updater,
+    normalize_updater,
+)
+
+
+@dataclass
+class MultiLayerConfiguration:
+    """Sequential-network config (MultiLayerConfiguration.java parity).
+
+    ``updater`` is the network default; a layer's ``updater`` field overrides
+    it (DL4J per-layer updater semantics). JSON round-trip via
+    to_json/from_json is the long-lived artifact contract (§5.6).
+    """
+
+    layers: Tuple[LayerConfig, ...] = ()
+    input_type: Optional[InputType] = None
+    seed: int = 12345
+    updater: Any = "sgd"
+    dtype: str = "float32"
+    backprop_type: str = "standard"        # "standard" | "tbptt"
+    tbptt_fwd_length: int = 20
+    tbptt_back_length: int = 20
+
+    def __post_init__(self):
+        self.layers = tuple(self.layers)
+
+    # -- serde -------------------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "format": "deeplearning4j_tpu/MultiLayerConfiguration",
+            "version": 1,
+            "layers": [l.to_dict() for l in self.layers],
+            "input_type": self.input_type.to_dict() if self.input_type else None,
+            "seed": self.seed,
+            "updater": _encode_value(self.updater),
+            "dtype": self.dtype,
+            "backprop_type": self.backprop_type,
+            "tbptt_fwd_length": self.tbptt_fwd_length,
+            "tbptt_back_length": self.tbptt_back_length,
+        }
+
+    def to_json(self, **kw) -> str:
+        return json.dumps(self.to_dict(), **kw)
+
+    @staticmethod
+    def from_dict(d: dict) -> "MultiLayerConfiguration":
+        return MultiLayerConfiguration(
+            layers=tuple(layer_from_dict(ld) for ld in d["layers"]),
+            input_type=InputType.from_dict(d["input_type"]) if d.get("input_type") else None,
+            seed=d.get("seed", 12345),
+            updater=d.get("updater", "sgd"),
+            dtype=d.get("dtype", "float32"),
+            backprop_type=d.get("backprop_type", "standard"),
+            tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
+            tbptt_back_length=d.get("tbptt_back_length", 20),
+        )
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        return MultiLayerConfiguration.from_dict(json.loads(s))
+
+
+def _as_batch(batch):
+    """Normalize a batch to (features, labels, features_mask, labels_mask).
+
+    Accepts (x, y), (x, y, fmask), (x, y, fmask, lmask) tuples or a dict with
+    those keys — the DataSet / MultiDataSet surface of the reference.
+    """
+    if isinstance(batch, dict):
+        return (
+            batch["features"],
+            batch.get("labels"),
+            batch.get("features_mask"),
+            batch.get("labels_mask"),
+        )
+    if isinstance(batch, (tuple, list)):
+        x = batch[0]
+        y = batch[1] if len(batch) > 1 else None
+        fm = batch[2] if len(batch) > 2 else None
+        lm = batch[3] if len(batch) > 3 else None
+        return x, y, fm, lm
+    return batch, None, None, None
+
+
+def _iter_batches(data, batch_size=None):
+    """Yield batches from (x, y[, masks]) arrays (optionally minibatched) or
+    any iterable of batches."""
+    if isinstance(data, (tuple, list)) and len(data) >= 2 and not isinstance(data[0], (tuple, list, dict)):
+        x, y, fm, lm = _as_batch(data)
+        n = len(x)
+        if batch_size is None or batch_size >= n:
+            yield (x, y, fm, lm)
+            return
+        for i in range(0, n, batch_size):  # final partial batch included
+            sl = slice(i, min(i + batch_size, n))
+            yield (
+                x[sl],
+                y[sl] if y is not None else None,
+                fm[sl] if fm is not None else None,
+                lm[sl] if lm is not None else None,
+            )
+        return
+    for b in data:
+        yield _as_batch(b)
+
+
+class MultiLayerNetwork:
+    """Stateful model facade over pure jitted functions.
+
+    Mutable host state: ``params``, ``state`` (BN running stats etc.),
+    ``opt_state``, ``iteration``. The jitted step itself is pure; this class
+    is the ergonomic shell matching the reference's MultiLayerNetwork API
+    (init/fit/output/score/evaluate/rnnTimeStep).
+    """
+
+    def __init__(self, conf: MultiLayerConfiguration):
+        if conf.input_type is None:
+            raise ValueError("MultiLayerConfiguration.input_type is required")
+        self.conf = conf
+        self.dtype = jnp.dtype(conf.dtype)
+        self._resolve_layers()
+        self.params = None
+        self.state = None
+        self.opt_state = None
+        self.iteration = 0
+        self.epoch = 0
+        self._rng = jax.random.PRNGKey(conf.seed)
+        self._step_fn = None
+        self._tbptt_step_fn = None
+        self._output_fn = None
+        self._rnn_carries: Optional[list] = None
+        self.listeners: list = []
+
+    # -- resolution: preprocessors + n_in inference + per-layer input types --
+    def _resolve_layers(self):
+        layers: List[LayerConfig] = []
+        input_types: List[InputType] = []
+        it = self.conf.input_type
+        for layer in self.conf.layers:
+            pre = infer_preprocessor(it, layer)
+            if pre is not None:
+                layers.append(pre)
+                input_types.append(it)
+                it = pre.output_type(it)
+            if hasattr(layer, "with_n_in"):
+                layer = layer.with_n_in(layer.infer_n_in(it))
+            layers.append(layer)
+            input_types.append(it)
+            it = layer.output_type(it)
+        self.layers: List[LayerConfig] = layers
+        self.layer_input_types: List[InputType] = input_types
+        self.output_type: InputType = it
+        self._carry_flags = [
+            isinstance(l, BaseRecurrent) and getattr(l, "SUPPORTS_CARRY", False) for l in layers
+        ]
+        out = self.layers[-1]
+        self._has_loss_head = hasattr(out, "score")
+
+    @property
+    def n_layers(self) -> int:
+        return len(self.layers)
+
+    # -- init --------------------------------------------------------------
+    def init(self, seed: Optional[int] = None) -> "MultiLayerNetwork":
+        key = jax.random.PRNGKey(self.conf.seed if seed is None else seed)
+        keys = jax.random.split(key, len(self.layers))
+        self.params = tuple(
+            l.init(k, it, self.dtype) for l, k, it in zip(self.layers, keys, self.layer_input_types)
+        )
+        self.state = tuple(l.init_state(it) for l, it in zip(self.layers, self.layer_input_types))
+        self._build_updaters()
+        self.opt_state = tuple(u.init(p) for u, p in zip(self._updaters, self.params))
+        self.iteration = 0
+        self.epoch = 0
+        return self
+
+    def _build_updaters(self):
+        default = normalize_updater(self.conf.updater)
+        self._updaters = []
+        for l in self.layers:
+            if not getattr(l, "trainable", True):
+                self._updaters.append(make_updater("noop"))
+            elif getattr(l, "updater", None) is not None:
+                self._updaters.append(make_updater(l.updater))
+            else:
+                self._updaters.append(make_updater(default))
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(self.params))
+
+    # -- forward -----------------------------------------------------------
+    def _forward(self, params, state, x, *, train, rngs, fmask=None, carries=None,
+                 upto: Optional[int] = None, collect=False):
+        """Walk the layer stack. Returns (act, new_state, new_carries, mask,
+        activations_list)."""
+        n = len(self.layers) if upto is None else upto
+        acts_list = []
+        new_state = list(state)
+        new_carries = list(carries) if carries is not None else None
+        mask = fmask
+        a = jnp.asarray(x, self.dtype) if not isinstance(x, jax.Array) else x
+        for i in range(n):
+            layer = self.layers[i]
+            lrng = rngs[i] if rngs is not None else None
+            if new_carries is not None and self._carry_flags[i]:
+                a2 = layer.maybe_dropout_input(a, train, lrng)
+                a, c = layer.apply_seq(params[i], a2, new_carries[i], mask)
+                new_carries[i] = c
+                ns = state[i]
+            else:
+                a, ns = layer.apply(params[i], state[i], a, train=train, rng=lrng, mask=mask)
+            new_state[i] = ns
+            mask = layer.propagate_mask(mask, self.layer_input_types[i])
+            if collect:
+                acts_list.append(a)
+        return a, tuple(new_state), (tuple(new_carries) if new_carries is not None else None), mask, acts_list
+
+    def _layer_rngs(self, rng):
+        return list(jax.random.split(rng, len(self.layers)))
+
+    def feed_forward(self, x, train: bool = False):
+        """All layer activations (MultiLayerNetwork.feedForward:878). Debug /
+        inspection path — not jitted."""
+        rngs = self._layer_rngs(self._next_rng()) if train else None
+        _, _, _, _, acts = self._forward(
+            self.params, self.state, x, train=train, rngs=rngs, collect=True
+        )
+        return acts
+
+    def _next_rng(self):
+        self._rng, k = jax.random.split(self._rng)
+        return k
+
+    # -- loss --------------------------------------------------------------
+    def _loss(self, params, state, x, y, fmask, lmask, rngs, carries=None, train=True):
+        """Average score incl. L1/L2 penalties; returns (loss, (new_state, carries))."""
+        a, new_state, new_carries, prop_mask, _ = self._forward(
+            params, state, x, train=train, rngs=rngs, fmask=fmask,
+            carries=carries, upto=len(self.layers) - 1,
+        )
+        out_layer = self.layers[-1]
+        out_mask = lmask if lmask is not None else prop_mask
+        loss = out_layer.score(params[-1], a, y, mask=out_mask, average=True)
+        # Unconditional: wrapper layers (Bidirectional etc.) delegate to their
+        # inner layer's l1/l2 even when the wrapper's own are zero.
+        reg = sum(l.regularization_penalty(p) for l, p in zip(self.layers, params))
+        return loss + reg, (new_state, new_carries)
+
+    # -- jitted step -------------------------------------------------------
+    def _make_step(self, with_carries: bool):
+        updaters = self._updaters
+        layers = self.layers
+
+        def step(params, opt_state, state, it, rng, x, y, fmask, lmask, carries):
+            rngs = list(jax.random.split(rng, len(layers)))
+
+            def loss_fn(p):
+                return self._loss(p, state, x, y, fmask, lmask, rngs,
+                                  carries if with_carries else None)
+
+            (loss, (new_state, new_carries)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+
+            new_params = []
+            new_opt = []
+            for i, (u, layer) in enumerate(zip(updaters, layers)):
+                g = grads[i]
+                if not g:  # param-free layer
+                    new_params.append(params[i])
+                    new_opt.append(opt_state[i])
+                    continue
+                gn = getattr(layer, "gradient_normalization", None)
+                if gn:
+                    g = apply_gradient_normalization(
+                        gn, getattr(layer, "gradient_normalization_threshold", 1.0), g
+                    )
+                upd, new_s = u.update(g, opt_state[i], params[i], it)
+                new_params.append(jax.tree_util.tree_map(lambda p, d: p - d, params[i], upd))
+                new_opt.append(new_s)
+            return tuple(new_params), tuple(new_opt), new_state, new_carries, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _get_step_fn(self, with_carries: bool):
+        if with_carries:
+            if self._tbptt_step_fn is None:
+                self._tbptt_step_fn = self._make_step(True)
+            return self._tbptt_step_fn
+        if self._step_fn is None:
+            self._step_fn = self._make_step(False)
+        return self._step_fn
+
+    # -- training ----------------------------------------------------------
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None):
+        """Train. ``data``: (x, y[, fmask[, lmask]]) arrays, an iterable of
+        such batches, or a callable returning a fresh iterable per epoch
+        (DataSetIterator equivalent)."""
+        if self.params is None:
+            self.init()
+        tbptt = self.conf.backprop_type == "tbptt"
+        for _ in range(epochs):
+            for l in self.listeners:
+                l.on_epoch_start(self, self.epoch)
+            source = data() if callable(data) else data
+            for x, y, fm, lm in _iter_batches(source, batch_size):
+                if tbptt and np.ndim(x) == 3:
+                    score = self._fit_tbptt(x, y, fm, lm)
+                else:
+                    score = self._fit_batch(x, y, fm, lm)
+                # score is a device scalar; only sync the host when a
+                # listener actually consumes it (keeps dispatch async)
+                if self.listeners:
+                    score = float(score)
+                    for l in self.listeners:
+                        l.iteration_done(self, self.iteration, score, len(x))
+            for l in self.listeners:
+                l.on_epoch_end(self, self.epoch)
+            self.epoch += 1
+        return self
+
+    def _fit_batch(self, x, y, fm, lm):
+        """One step. Returns the loss as a DEVICE scalar — callers decide
+        whether to sync (fit() only syncs when listeners are attached)."""
+        step = self._get_step_fn(False)
+        x = jnp.asarray(x, self.dtype)
+        y = jnp.asarray(y, self.dtype) if y is not None else None
+        fm = jnp.asarray(fm, self.dtype) if fm is not None else None
+        lm = jnp.asarray(lm, self.dtype) if lm is not None else None
+        self.params, self.opt_state, self.state, _, loss = step(
+            self.params, self.opt_state, self.state,
+            jnp.asarray(self.iteration, jnp.int32), self._next_rng(),
+            x, y, fm, lm, (),
+        )
+        self.iteration += 1
+        return loss
+
+    def _fit_tbptt(self, x, y, fm, lm):
+        """Truncated BPTT: chunk the time axis, carry RNN state across chunks
+        (doTruncatedBPTT:1514 — forward/backward chunk length unified)."""
+        step = self._get_step_fn(True)
+        T = x.shape[1]
+        L = self.conf.tbptt_fwd_length
+        carries = tuple(
+            l.initial_carry(x.shape[0], self.dtype) if f else ()
+            for l, f in zip(self.layers, self._carry_flags)
+        )
+        total, nchunks = 0.0, 0
+        for t0 in range(0, T, L):
+            sl = slice(t0, min(t0 + L, T))
+            xc = jnp.asarray(x[:, sl], self.dtype)
+            yc = jnp.asarray(y[:, sl], self.dtype) if y is not None and np.ndim(y) == 3 else (
+                jnp.asarray(y, self.dtype) if y is not None else None)
+            fmc = jnp.asarray(fm[:, sl], self.dtype) if fm is not None else None
+            lmc = jnp.asarray(lm[:, sl], self.dtype) if lm is not None else None
+            self.params, self.opt_state, self.state, carries, loss = step(
+                self.params, self.opt_state, self.state,
+                jnp.asarray(self.iteration, jnp.int32), self._next_rng(),
+                xc, yc, fmc, lmc, carries,
+            )
+            # carries cross chunk boundaries without gradient flow (truncation)
+            carries = jax.tree_util.tree_map(jax.lax.stop_gradient, carries)
+            total = total + loss  # device-side accumulation, no host sync
+            nchunks += 1
+            self.iteration += 1
+        return total / max(nchunks, 1)
+
+    # -- inference ---------------------------------------------------------
+    def output(self, x, train: bool = False, fmask=None):
+        """Final-layer post-activation output (MultiLayerNetwork.output:2005),
+        jit-compiled inference path."""
+        if self._output_fn is None:
+            def fwd(params, state, x, fmask):
+                a, _, _, _, _ = self._forward(params, state, x, train=False, rngs=None,
+                                              fmask=fmask)
+                return a
+
+            self._output_fn = jax.jit(fwd)
+        return self._output_fn(self.params, self.state,
+                               jnp.asarray(x, self.dtype),
+                               jnp.asarray(fmask, self.dtype) if fmask is not None else None)
+
+    def predict(self, x) -> np.ndarray:
+        return np.asarray(self.output(x)).argmax(axis=-1)
+
+    def score(self, batch_or_x, y=None, fmask=None, lmask=None) -> float:
+        """Average loss on a batch (MultiLayerNetwork.score)."""
+        if y is None:
+            x, y, fmask, lmask = _as_batch(batch_or_x)
+        else:
+            x = batch_or_x
+        loss, _ = self._loss(
+            self.params, self.state,
+            jnp.asarray(x, self.dtype), jnp.asarray(y, self.dtype),
+            jnp.asarray(fmask, self.dtype) if fmask is not None else None,
+            jnp.asarray(lmask, self.dtype) if lmask is not None else None,
+            rngs=None,
+            train=False,
+        )
+        return float(loss)
+
+    # -- evaluation --------------------------------------------------------
+    def _output_mask(self, fm, lm):
+        """Mask for scoring/eval at the network output: the labels mask, or
+        the features mask propagated through the layer stack (matches _loss)."""
+        if lm is not None:
+            return np.asarray(lm)
+        if fm is None:
+            return None
+        mask = jnp.asarray(fm, self.dtype)
+        for layer, it in zip(self.layers, self.layer_input_types):
+            mask = layer.propagate_mask(mask, it)
+            if mask is None:
+                return None
+        return np.asarray(mask)
+
+    def evaluate(self, data, batch_size: Optional[int] = None, top_n: int = 1):
+        from deeplearning4j_tpu.eval import Evaluation
+
+        ev = Evaluation(top_n=top_n)
+        for x, y, fm, lm in _iter_batches(data, batch_size):
+            preds = self.output(x, fmask=fm)
+            ev.eval(np.asarray(y), np.asarray(preds), mask=self._output_mask(fm, lm))
+        return ev
+
+    def evaluate_regression(self, data, batch_size: Optional[int] = None):
+        from deeplearning4j_tpu.eval import RegressionEvaluation
+
+        ev = RegressionEvaluation()
+        for x, y, fm, lm in _iter_batches(data, batch_size):
+            preds = self.output(x, fmask=fm)
+            ev.eval(np.asarray(y), np.asarray(preds), mask=self._output_mask(fm, lm))
+        return ev
+
+    def evaluate_roc(self, data, batch_size: Optional[int] = None, num_bins: int = 200):
+        from deeplearning4j_tpu.eval import ROC
+
+        roc = ROC(num_bins)
+        for x, y, fm, lm in _iter_batches(data, batch_size):
+            preds = self.output(x, fmask=fm)
+            roc.eval(np.asarray(y), np.asarray(preds))
+        return roc
+
+    # -- streaming RNN inference (rnnTimeStep:2371) ------------------------
+    def rnn_time_step(self, x):
+        """Feed one or more timesteps, carrying RNN state between calls."""
+        x = jnp.asarray(x, self.dtype)
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[:, None, :]
+        leaves = (
+            jax.tree_util.tree_leaves(self._rnn_carries) if self._rnn_carries is not None else []
+        )
+        if self._rnn_carries is None or (leaves and leaves[0].shape[0] != x.shape[0]):
+            self._rnn_carries = tuple(
+                l.initial_carry(x.shape[0], self.dtype) if f else ()
+                for l, f in zip(self.layers, self._carry_flags)
+            )
+        a, _, new_carries, _, _ = self._forward(
+            self.params, self.state, x, train=False, rngs=None, carries=self._rnn_carries
+        )
+        self._rnn_carries = new_carries
+        return a[:, 0, :] if squeeze and a.ndim == 3 else a
+
+    def rnn_clear_previous_state(self):
+        self._rnn_carries = None
+
+    # -- persistence hooks (utils/serialization.py drives these) -----------
+    def clone(self) -> "MultiLayerNetwork":
+        m = MultiLayerNetwork(self.conf)
+        if self.params is not None:
+            m.init()
+            # Deep copy: the jitted step DONATES params/opt_state/state, so
+            # aliasing the live buffers would leave the clone pointing at
+            # deleted arrays after the next fit() on either model.
+            copy = lambda t: jax.tree_util.tree_map(jnp.copy, t)
+            m.params = copy(self.params)
+            m.state = copy(self.state)
+            m.opt_state = copy(self.opt_state)
+            m.iteration = self.iteration
+            m.epoch = self.epoch
+        return m
+
+    def summary(self) -> str:
+        lines = [f"{'idx':<4} {'type':<22} {'output':<24} {'params':<10}"]
+        it_in = None
+        for i, (l, it) in enumerate(zip(self.layers, self.layer_input_types)):
+            out = l.output_type(it)
+            n = (
+                sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(self.params[i]))
+                if self.params is not None
+                else "?"
+            )
+            lines.append(f"{i:<4} {l._type_name:<22} {str(out.batch_shape())[0:24]:<24} {n:<10}")
+        lines.append(f"Total params: {self.num_params() if self.params is not None else '?'}")
+        return "\n".join(lines)

@@ -136,7 +136,15 @@ class Reshape(LayerConfig):
 def infer_preprocessor(from_type: InputType, to_layer) -> Optional[LayerConfig]:
     """Auto-insert a shape adapter, mirroring the reference's
     ``setInputType``/preprocessor inference. Returns None if shapes already
-    line up."""
+    line up.
+
+    Three layer groups matter:
+    - conv layers (need [b,h,w,c] input),
+    - rnn layers (need [b,t,f] input),
+    - shape-preserving layers (BatchNorm, dropout/noise, activation, global
+      pooling): consume ANY rank natively — never insert an adapter for them.
+    Everything else (Dense, Output, Embedding, ...) consumes flat [b, f].
+    """
     from deeplearning4j_tpu.nn.layers.convolution import (
         Conv1D,
         Conv2D,
@@ -145,12 +153,31 @@ def infer_preprocessor(from_type: InputType, to_layer) -> Optional[LayerConfig]:
         Upsampling2D,
         ZeroPadding2D,
     )
+    from deeplearning4j_tpu.nn.layers.core import (
+        ActivationLayer,
+        AlphaDropout,
+        DropoutLayer,
+        GaussianDropout,
+        GaussianNoise,
+    )
     from deeplearning4j_tpu.nn.layers.normalization import BatchNorm, LocalResponseNormalization
+    from deeplearning4j_tpu.nn.layers.pooling import GlobalPooling
     from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrent, Bidirectional, LastTimeStep, MaskZero
 
     conv_layers = (Conv2D, Subsampling2D, Upsampling2D, ZeroPadding2D, LocalResponseNormalization)
     rnn_layers = (BaseRecurrent, Bidirectional, LastTimeStep, MaskZero, Conv1D, Subsampling1D)
+    shape_preserving = (
+        BatchNorm,
+        GlobalPooling,
+        ActivationLayer,
+        DropoutLayer,
+        GaussianNoise,
+        GaussianDropout,
+        AlphaDropout,
+    )
 
+    if isinstance(to_layer, shape_preserving):
+        return None
     if isinstance(to_layer, conv_layers) and from_type.kind == "conv_flat":
         return FeedForwardToCnn(height=from_type.height, width=from_type.width, channels=from_type.channels)
     if isinstance(to_layer, conv_layers) and from_type.kind == "ff":

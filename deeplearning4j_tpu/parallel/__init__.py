@@ -1,0 +1,17 @@
+"""Parallelism: device meshes, data-parallel training, parallel inference.
+
+TPU-native replacement for the reference's entire scaleout stack
+(SURVEY.md §2.5): ParallelWrapper's averaging/gradient-sharing modes, both
+Spark TrainingMasters, and the Aeron VoidParameterServer all collapse into
+ONE mechanism — a jitted train step whose batch is sharded over a mesh axis
+and whose gradients are all-reduced by XLA collectives over ICI (DCN across
+slices). Threshold compression (EncodedGradientsAccumulator) is deliberately
+absent: it existed because Ethernet was the bottleneck; ICI makes dense
+bf16/f32 all-reduce cheaper than encode/decode (SURVEY.md §5.8).
+"""
+
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+__all__ = ["MeshSpec", "make_mesh", "ParallelWrapper", "ParallelInference"]

@@ -1,0 +1,93 @@
+"""Parallel inference with request batching.
+
+Parity: parallelism/ParallelInference.java:32 (modes:52, output:110-136) and
+inference/observers/BatchedInferenceObservable.java. The reference keeps N
+model replicas on N devices with a batching queue; on TPU one sharded model
+serves all chips, so the capability reduces to: (a) a thread-safe front that
+coalesces small requests into padded batches (the BATCHED mode), (b) direct
+pass-through (INPLACE/SEQUENTIAL modes).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+
+class _Pending:
+    __slots__ = ("x", "event", "result")
+
+    def __init__(self, x):
+        self.x = x
+        self.event = threading.Event()
+        self.result = None
+
+
+class ParallelInference:
+    """Batched inference front-end.
+
+    ``mode``: "inplace" (call straight through) or "batched" (coalesce up to
+    ``max_batch_size`` queued requests into one device call).
+    """
+
+    def __init__(self, model, mode: str = "batched", max_batch_size: int = 32,
+                 queue_limit: int = 64, worker: bool = True):
+        self.model = model
+        self.mode = mode
+        self.max_batch_size = max_batch_size
+        self._queue: "queue.Queue[_Pending]" = queue.Queue(maxsize=queue_limit)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if mode == "batched" and worker:
+            self._thread = threading.Thread(target=self._worker_loop, daemon=True)
+            self._thread.start()
+
+    # -- public ------------------------------------------------------------
+    def output(self, x) -> np.ndarray:
+        x = np.asarray(x)
+        if self.mode != "batched" or self._thread is None:
+            return np.asarray(self.model.output(x))
+        p = _Pending(x)
+        self._queue.put(p)
+        p.event.wait()
+        if isinstance(p.result, Exception):
+            raise p.result
+        return p.result
+
+    def shutdown(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._queue.put(_Pending(None))  # wake the worker
+            self._thread.join(timeout=5)
+
+    # -- worker ------------------------------------------------------------
+    def _drain(self) -> List[_Pending]:
+        batch = [self._queue.get()]
+        while len(batch) < self.max_batch_size:
+            try:
+                batch.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        return [p for p in batch if p.x is not None]
+
+    def _worker_loop(self):
+        while not self._stop.is_set():
+            batch = self._drain()
+            if not batch:
+                continue
+            try:
+                sizes = [len(p.x) for p in batch]
+                xs = np.concatenate([p.x for p in batch], axis=0)
+                out = np.asarray(self.model.output(xs))
+                ofs = 0
+                for p, n in zip(batch, sizes):
+                    p.result = out[ofs : ofs + n]
+                    ofs += n
+                    p.event.set()
+            except Exception as e:  # propagate to all waiters
+                for p in batch:
+                    p.result = e
+                    p.event.set()

@@ -1,0 +1,59 @@
+"""Device-mesh construction.
+
+The mesh is the framework's one abstraction for every parallelism flavor:
+- ``data``: data parallelism (replaces ParallelWrapper + both Spark masters)
+- ``model``: tensor parallelism (sharded weight matrices; new capability —
+  the reference has none, SURVEY.md §2.5)
+- ``seq``: sequence/context parallelism for long sequences (ring attention
+  lives on this axis)
+
+Single-host multi-chip uses all local devices; multi-host uses
+``jax.distributed.initialize`` + the same code (SPMD: every host runs the
+same program over its address-local shard of the global batch).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape. -1 on one axis means 'all remaining devices'."""
+
+    data: int = -1
+    model: int = 1
+    seq: int = 1
+
+    def resolve(self, n_devices: int) -> Tuple[int, int, int]:
+        d, m, s = self.data, self.model, self.seq
+        fixed = (m if m > 0 else 1) * (s if s > 0 else 1)
+        if d == -1:
+            d = n_devices // fixed
+        if d * m * s != n_devices:
+            raise ValueError(
+                f"MeshSpec {d}x{m}x{s} does not cover {n_devices} devices"
+            )
+        return d, m, s
+
+
+def make_mesh(spec: Optional[MeshSpec] = None, devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices) if devices is not None else jax.devices()
+    spec = spec or MeshSpec()
+    d, m, s = spec.resolve(len(devices))
+    arr = np.array(devices).reshape(d, m, s)
+    return Mesh(arr, ("data", "model", "seq"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """Shard axis 0 (batch) over the data axis, replicate the rest."""
+    return NamedSharding(mesh, P("data", *([None] * (ndim - 1))))

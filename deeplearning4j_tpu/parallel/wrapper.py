@@ -1,0 +1,101 @@
+"""Data-parallel training over a device mesh.
+
+Capability parity with ParallelWrapper
+(/root/reference/deeplearning4j-scaleout/deeplearning4j-scaleout-parallelwrapper/
+src/main/java/org/deeplearning4j/parallelism/ParallelWrapper.java:58) and the
+Spark TrainingMasters — re-designed TPU-first. Where the reference spawns one
+replica thread per device and averages parameters every N iterations (or
+threshold-encodes gradient updates into a shared ring buffer), here the SAME
+jitted step the single-chip path uses is simply fed a globally-sharded batch:
+params live replicated on every chip, the batch is split along the ``data``
+mesh axis, and XLA inserts the gradient all-reduce (psum over ICI) during
+compilation. Parameter averaging, gradient sharing, and the parameter server
+are all THIS one mechanism — exact (no compression loss), synchronous, and
+overlapped with backprop by the compiler.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.nn.model import _iter_batches
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+
+
+class ParallelWrapper:
+    """Drop-in accelerator for a MultiLayerNetwork/ComputationGraph: same
+    ``fit`` surface, batch sharded over the mesh's ``data`` axis.
+
+    Usage::
+
+        pw = ParallelWrapper(model)          # all local devices
+        pw.fit((x, y), epochs=10, batch_size=512)
+
+    The global batch must divide by the data-axis size (the reference
+    round-robins whole DataSets to workers; here the sharding is exact).
+    """
+
+    def __init__(self, model, mesh: Optional[Mesh] = None):
+        self.model = model
+        self.mesh = mesh if mesh is not None else make_mesh(MeshSpec())
+        self.n_data = self.mesh.shape["data"]
+        self._repl = NamedSharding(self.mesh, P())
+
+    def _shard(self, arr):
+        if arr is None:
+            return None
+        arr = jnp.asarray(arr, self.model.dtype)
+        spec = P("data", *([None] * (arr.ndim - 1)))
+        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+
+    def _replicate_model(self):
+        put = lambda t: jax.device_put(t, self._repl)
+        self.model.params = jax.tree_util.tree_map(put, self.model.params)
+        self.model.state = jax.tree_util.tree_map(put, self.model.state)
+        if self.model.opt_state is not None:
+            self.model.opt_state = jax.tree_util.tree_map(put, self.model.opt_state)
+
+    def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None):
+        """Data-parallel fit: identical semantics to ``model.fit`` on a batch
+        ``batch_size`` large, executed across all chips."""
+        if self.model.params is None:
+            self.model.init()
+        self._replicate_model()
+        model = self.model
+        for _ in range(epochs):
+            for l in model.listeners:
+                l.on_epoch_start(model, model.epoch)
+            source = data() if callable(data) else data
+            for x, y, fm, lm in _iter_batches(source, batch_size):
+                n = len(x)
+                if n % self.n_data != 0:
+                    # pad to a shardable batch (masked examples would be
+                    # better; DL4J just sends uneven batches to workers)
+                    pad = self.n_data - n % self.n_data
+                    x = np.concatenate([np.asarray(x), np.asarray(x)[:pad]])
+                    if y is not None:
+                        y = np.concatenate([np.asarray(y), np.asarray(y)[:pad]])
+                    if fm is not None:
+                        fm = np.concatenate([np.asarray(fm), np.asarray(fm)[:pad]])
+                    if lm is not None:
+                        lm = np.concatenate([np.asarray(lm), np.asarray(lm)[:pad]])
+                score = model._fit_batch(
+                    self._shard(x), self._shard(y), self._shard(fm), self._shard(lm)
+                )
+                if model.listeners:
+                    score = float(score)
+                    for l in model.listeners:
+                        l.iteration_done(model, model.iteration, score, n)
+            for l in model.listeners:
+                l.on_epoch_end(model, model.epoch)
+            model.epoch += 1
+        return model
+
+    def output(self, x):
+        """Sharded batched inference across the mesh."""
+        return self.model.output(self._shard(np.asarray(x)))

@@ -1,0 +1,40 @@
+"""Training: updaters (optimizers), schedules, listeners, the fit loop.
+
+TPU-native replacement for the reference's Solver/updater stack
+(/root/reference/deeplearning4j-nn/.../optimize/Solver.java:50,
+ nn/updater/BaseMultiLayerUpdater.java): instead of an iteration driver
+mutating a flattened param view through per-block GradientUpdaters, the whole
+``step(params, opt_state, batch) -> (params, opt_state, metrics)`` is a pure
+function compiled once by XLA, with optimizer state as a pytree sharded
+alongside the params.
+"""
+
+from deeplearning4j_tpu.train.updaters import (
+    Updater,
+    make_updater,
+    normalize_updater,
+    schedule_value,
+)
+from deeplearning4j_tpu.train.listeners import (
+    BaseTrainingListener,
+    CollectScoresListener,
+    ComposedListener,
+    PerformanceListener,
+    ScoreIterationListener,
+    TimeIterationListener,
+    TrainingListener,
+)
+
+__all__ = [
+    "Updater",
+    "make_updater",
+    "normalize_updater",
+    "schedule_value",
+    "TrainingListener",
+    "BaseTrainingListener",
+    "ScoreIterationListener",
+    "PerformanceListener",
+    "CollectScoresListener",
+    "TimeIterationListener",
+    "ComposedListener",
+]

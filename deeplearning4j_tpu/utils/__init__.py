@@ -1,0 +1,8 @@
+"""Utilities: model serialization, gradient checking."""
+
+from deeplearning4j_tpu.utils.serialization import (
+    restore_network,
+    save_network,
+)
+
+__all__ = ["save_network", "restore_network"]

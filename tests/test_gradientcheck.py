@@ -1,0 +1,153 @@
+"""Gradient checks: numeric vs analytic, fp64 — the correctness backbone
+(reference: deeplearning4j-core gradientcheck suites, GradientCheckUtil:109)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers import (
+    BatchNorm,
+    Conv2D,
+    Dense,
+    GlobalPooling,
+    GravesLSTM,
+    LSTM,
+    OutputLayer,
+    RnnOutputLayer,
+    SimpleRnn,
+    Subsampling2D,
+)
+from deeplearning4j_tpu.nn.model import MultiLayerConfiguration, MultiLayerNetwork
+from deeplearning4j_tpu.utils.gradientcheck import check_gradients
+
+
+def _check(conf, x, y, **kw):
+    model = MultiLayerNetwork(conf).init()
+    assert check_gradients(model, x, y, subset=8, print_results=True, **kw)
+
+
+class TestGradientChecks:
+    def test_mlp_softmax_mcxent(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(6, 4)
+        y = np.eye(3)[rs.randint(0, 3, 6)]
+        conf = MultiLayerConfiguration(
+            layers=(
+                Dense(n_out=5, activation="tanh"),
+                OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+            ),
+            input_type=InputType.feed_forward(4),
+        )
+        _check(conf, x, y)
+
+    def test_mlp_with_l1_l2(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(5, 4)
+        y = np.eye(2)[rs.randint(0, 2, 5)]
+        conf = MultiLayerConfiguration(
+            layers=(
+                Dense(n_out=6, activation="sigmoid", l1=0.01, l2=0.02),
+                OutputLayer(n_out=2, activation="softmax", l2=0.01),
+            ),
+            input_type=InputType.feed_forward(4),
+        )
+        _check(conf, x, y)
+
+    def test_mse_identity_regression(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(6, 3)
+        y = rs.randn(6, 2)
+        conf = MultiLayerConfiguration(
+            layers=(
+                Dense(n_out=5, activation="elu"),
+                OutputLayer(n_out=2, activation="identity", loss="mse"),
+            ),
+            input_type=InputType.feed_forward(3),
+        )
+        _check(conf, x, y)
+
+    def test_cnn(self):
+        rs = np.random.RandomState(3)
+        x = rs.randn(4, 6, 6, 2)
+        y = np.eye(2)[rs.randint(0, 2, 4)]
+        conf = MultiLayerConfiguration(
+            layers=(
+                Conv2D(n_out=3, kernel=(3, 3), activation="tanh"),
+                Subsampling2D(kernel=(2, 2), stride=(2, 2)),
+                OutputLayer(n_out=2, activation="softmax"),
+            ),
+            input_type=InputType.convolutional(6, 6, 2),
+        )
+        _check(conf, x, y)
+
+    def test_batchnorm(self):
+        rs = np.random.RandomState(4)
+        x = rs.randn(8, 4)
+        y = np.eye(2)[rs.randint(0, 2, 8)]
+        conf = MultiLayerConfiguration(
+            layers=(
+                Dense(n_out=6, activation="identity"),
+                BatchNorm(),
+                OutputLayer(n_out=2, activation="softmax"),
+            ),
+            input_type=InputType.feed_forward(4),
+        )
+        # BN in eval mode for the check (running stats fixed), like the
+        # reference which checks BN gradients with minibatch stats held fixed.
+        _check(conf, x, y)
+
+    def test_lstm(self):
+        rs = np.random.RandomState(5)
+        x = rs.randn(3, 5, 4)
+        y = np.eye(2)[rs.randint(0, 2, (3, 5))]
+        conf = MultiLayerConfiguration(
+            layers=(
+                LSTM(n_out=4),
+                RnnOutputLayer(n_out=2, activation="softmax"),
+            ),
+            input_type=InputType.recurrent(4, 5),
+        )
+        _check(conf, x, y)
+
+    def test_graves_lstm_masked(self):
+        rs = np.random.RandomState(6)
+        x = rs.randn(3, 5, 4)
+        y = np.eye(2)[rs.randint(0, 2, (3, 5))]
+        mask = np.ones((3, 5))
+        mask[0, 3:] = 0
+        mask[2, 4:] = 0
+        conf = MultiLayerConfiguration(
+            layers=(
+                GravesLSTM(n_out=4),
+                RnnOutputLayer(n_out=2, activation="softmax"),
+            ),
+            input_type=InputType.recurrent(4, 5),
+        )
+        _check(conf, x, y, fmask=mask, lmask=mask)
+
+    def test_simple_rnn_global_pooling(self):
+        rs = np.random.RandomState(7)
+        x = rs.randn(3, 6, 4)
+        y = np.eye(2)[rs.randint(0, 2, 3)]
+        conf = MultiLayerConfiguration(
+            layers=(
+                SimpleRnn(n_out=4),
+                GlobalPooling(pooling="mean"),
+                OutputLayer(n_out=2, activation="softmax"),
+            ),
+            input_type=InputType.recurrent(4, 6),
+        )
+        _check(conf, x, y)
+
+    def test_xent_sigmoid(self):
+        rs = np.random.RandomState(8)
+        x = rs.randn(6, 3)
+        y = rs.randint(0, 2, (6, 4)).astype(float)
+        conf = MultiLayerConfiguration(
+            layers=(
+                Dense(n_out=5, activation="relu"),
+                OutputLayer(n_out=4, activation="sigmoid", loss="xent"),
+            ),
+            input_type=InputType.feed_forward(3),
+        )
+        _check(conf, x, y)

@@ -1,0 +1,132 @@
+"""Parallelism tests on 8 virtual CPU devices (conftest sets
+xla_force_host_platform_device_count=8) — the single-process multi-worker
+pattern from SURVEY.md §4."""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+from deeplearning4j_tpu.nn.model import MultiLayerConfiguration, MultiLayerNetwork
+from deeplearning4j_tpu.parallel import MeshSpec, ParallelInference, ParallelWrapper, make_mesh
+
+
+def _model(seed=3):
+    conf = MultiLayerConfiguration(
+        layers=(
+            Dense(n_out=16, activation="tanh"),
+            OutputLayer(n_out=2, activation="softmax"),
+        ),
+        input_type=InputType.feed_forward(4),
+        updater={"type": "sgd", "lr": 0.1},
+        seed=seed,
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(axis=1) > 0).astype(int)]
+    return x, y
+
+
+class TestMesh:
+    def test_mesh_shapes(self):
+        mesh = make_mesh(MeshSpec(data=8))
+        assert mesh.shape["data"] == 8
+        mesh = make_mesh(MeshSpec(data=4, model=2))
+        assert mesh.shape == {"data": 4, "model": 2, "seq": 1}
+
+    def test_bad_spec_raises(self):
+        with pytest.raises(ValueError):
+            make_mesh(MeshSpec(data=3, model=2))  # 6 != 8
+
+
+class TestParallelWrapper:
+    def test_dp_fit_matches_single_device_semantics(self):
+        """Same data, same seed: DP over 8 chips must produce the SAME params
+        as single-device fit on the full batch (exact data parallelism — the
+        reference's averaging is approximate; ours is bitwise the same math)."""
+        x, y = _data(64)
+        m1 = _model(seed=5)
+        m2 = _model(seed=5)
+        # align dropout rngs: no dropout in this net, so only data order matters
+        m1.fit((x, y), epochs=5)
+
+        pw = ParallelWrapper(m2, mesh=make_mesh(MeshSpec(data=8)))
+        pw.fit((x, y), epochs=5)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(m1.params), jax.tree_util.tree_leaves(m2.params)
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5)
+
+    def test_dp_fit_reduces_score(self):
+        x, y = _data(128)
+        model = _model()
+        pw = ParallelWrapper(model, mesh=make_mesh(MeshSpec(data=8)))
+        s0 = model.score(x, y)
+        pw.fit((x, y), epochs=20, batch_size=64)
+        assert model.score(x, y) < s0 * 0.8
+
+    def test_uneven_batch_padding(self):
+        x, y = _data(60)  # not divisible by 8
+        model = _model()
+        pw = ParallelWrapper(model, mesh=make_mesh(MeshSpec(data=8)))
+        pw.fit((x, y), epochs=1)
+        assert model.iteration == 1
+
+    def test_sharded_output(self):
+        x, y = _data(32)
+        model = _model()
+        pw = ParallelWrapper(model, mesh=make_mesh(MeshSpec(data=8)))
+        pw.fit((x, y), epochs=1)
+        out = np.asarray(pw.output(x))
+        assert out.shape == (32, 2)
+
+
+class TestParallelInference:
+    def test_inplace_mode(self):
+        model = _model()
+        x, _ = _data(16)
+        pi = ParallelInference(model, mode="inplace")
+        np.testing.assert_allclose(
+            np.asarray(pi.output(x)), np.asarray(model.output(x)), rtol=1e-6
+        )
+
+    def test_batched_mode_coalesces(self):
+        model = _model()
+        x, _ = _data(24)
+        pi = ParallelInference(model, mode="batched", max_batch_size=8)
+        try:
+            import concurrent.futures as cf
+
+            with cf.ThreadPoolExecutor(8) as ex:
+                futs = [ex.submit(pi.output, x[i : i + 3]) for i in range(0, 24, 3)]
+                outs = [f.result(timeout=30) for f in futs]
+            direct = np.asarray(model.output(x))
+            got = np.concatenate(outs, axis=0)
+            np.testing.assert_allclose(got, direct, rtol=1e-5, atol=1e-6)
+        finally:
+            pi.shutdown()
+
+
+class TestGraftEntry:
+    def test_entry_compiles(self):
+        import sys
+
+        sys.path.insert(0, "/root/repo")
+        import __graft_entry__ as g
+
+        fn, args = g.entry()
+        out = jax.jit(fn)(*args)
+        assert out.shape[-1] == 10
+
+    def test_dryrun_multichip(self):
+        import sys
+
+        sys.path.insert(0, "/root/repo")
+        import __graft_entry__ as g
+
+        g.dryrun_multichip(8)

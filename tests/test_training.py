@@ -1,0 +1,434 @@
+"""Training-stack tests: updaters, fit loop, serialization, evaluation.
+
+Mirrors the reference's core test style (MultiLayerTest, BackPropMLPTest,
+updater tests — SURVEY.md §4): tiny nets, fixed seeds, convergence and
+round-trip assertions.
+"""
+
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.eval import (
+    Evaluation,
+    EvaluationBinary,
+    EvaluationCalibration,
+    RegressionEvaluation,
+    ROC,
+    ROCMultiClass,
+)
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers import (
+    BatchNorm,
+    Conv2D,
+    Dense,
+    DropoutLayer,
+    LSTM,
+    OutputLayer,
+    RnnOutputLayer,
+    SimpleRnn,
+    Subsampling2D,
+)
+from deeplearning4j_tpu.nn.model import MultiLayerConfiguration, MultiLayerNetwork
+from deeplearning4j_tpu.train import (
+    CollectScoresListener,
+    ScoreIterationListener,
+    make_updater,
+    schedule_value,
+)
+from deeplearning4j_tpu.train.updaters import apply_gradient_normalization
+from deeplearning4j_tpu.utils.serialization import restore_network, save_network
+
+
+def two_moons(n=200, seed=0):
+    """Tiny separable 2-class dataset."""
+    rs = np.random.RandomState(seed)
+    n2 = n // 2
+    t = rs.uniform(0, np.pi, n2)
+    x0 = np.stack([np.cos(t), np.sin(t)], -1) + 0.1 * rs.randn(n2, 2)
+    x1 = np.stack([1 - np.cos(t), 0.5 - np.sin(t)], -1) + 0.1 * rs.randn(n2, 2)
+    x = np.concatenate([x0, x1]).astype(np.float32)
+    y = np.zeros((n, 2), np.float32)
+    y[:n2, 0] = 1
+    y[n2:, 1] = 1
+    perm = rs.permutation(n)
+    return x[perm], y[perm]
+
+
+class TestUpdaters:
+    @pytest.mark.parametrize(
+        "spec",
+        ["sgd", "adam", "adamax", "nadam", "amsgrad", "nesterovs", "adagrad",
+         "rmsprop", {"type": "adadelta"}],
+    )
+    def test_minimizes_quadratic(self, spec):
+        u = make_updater(spec if isinstance(spec, dict) else {"type": spec, "lr": 0.1})
+        params = {"w": jnp.array([3.0, -2.0])}
+        s = u.init(params)
+        for it in range(1000):
+            g = {"w": 2 * params["w"]}  # d/dw of w^2
+            upd, s = u.update(g, s, params, it)
+            params = jax.tree_util.tree_map(lambda p, d: p - d, params, upd)
+        assert float(jnp.abs(params["w"]).max()) < 0.3, spec
+
+    def test_noop_does_nothing(self):
+        u = make_updater("noop")
+        params = {"w": jnp.array([1.0])}
+        upd, _ = u.update({"w": jnp.array([5.0])}, u.init(params), params, 0)
+        assert float(upd["w"][0]) == 0.0
+
+    def test_schedules(self):
+        assert float(schedule_value(None, 0.1, 5)) == pytest.approx(0.1)
+        assert float(schedule_value({"policy": "exponential", "decay_rate": 0.5}, 1.0, 2)) == pytest.approx(0.25)
+        assert float(schedule_value({"policy": "step", "decay_rate": 0.1, "step_size": 10}, 1.0, 25)) == pytest.approx(0.01)
+        m = schedule_value({"policy": "map", "schedule": {"0": 1.0, "10": 0.5}}, 1.0, 15)
+        assert float(m) == pytest.approx(0.5)
+        w = schedule_value({"policy": "warmup_cosine", "warmup": 10, "max_iter": 110}, 1.0, 5)
+        assert float(w) == pytest.approx(0.5)
+
+    def test_gradient_normalization_modes(self):
+        g = {"W": jnp.array([3.0, 4.0]), "b": jnp.array([0.0])}
+        out = apply_gradient_normalization("clip_l2_per_layer", 1.0, g)
+        norm = float(jnp.sqrt(sum(jnp.sum(v * v) for v in jax.tree_util.tree_leaves(out))))
+        assert norm == pytest.approx(1.0, rel=1e-4)
+        out = apply_gradient_normalization("clip_elementwise_absolute_value", 2.0, g)
+        assert float(out["W"].max()) == pytest.approx(2.0)
+        out = apply_gradient_normalization("renormalize_l2_per_param_type", 1.0, g)
+        assert float(jnp.linalg.norm(out["W"])) == pytest.approx(1.0, rel=1e-3)
+
+
+class TestMultiLayerNetwork:
+    def _mlp_conf(self, updater="adam", **kw):
+        return MultiLayerConfiguration(
+            layers=(
+                Dense(n_out=16, activation="tanh"),
+                OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+            ),
+            input_type=InputType.feed_forward(2),
+            updater={"type": updater, "lr": 0.05},
+            seed=42,
+            **kw,
+        )
+
+    def test_fit_reduces_score_and_classifies(self):
+        x, y = two_moons()
+        model = MultiLayerNetwork(self._mlp_conf()).init()
+        scores = CollectScoresListener()
+        model.set_listeners(scores, ScoreIterationListener(50, out=lambda s: None))
+        s0 = model.score(x, y)
+        model.fit((x, y), epochs=60)
+        s1 = model.score(x, y)
+        assert s1 < s0 * 0.5
+        ev = model.evaluate((x, y))
+        assert ev.accuracy() > 0.9
+        assert len(scores.scores) == 60
+
+    def test_minibatch_fit(self):
+        x, y = two_moons(128)
+        model = MultiLayerNetwork(self._mlp_conf()).init()
+        model.fit((x, y), epochs=10, batch_size=32)
+        assert model.iteration == 40
+
+    def test_feed_forward_collects_activations(self):
+        x, y = two_moons(8)
+        model = MultiLayerNetwork(self._mlp_conf()).init()
+        acts = model.feed_forward(x)
+        assert len(acts) == 2
+        assert acts[0].shape == (8, 16)
+        assert acts[1].shape == (8, 2)
+
+    def test_conf_json_roundtrip(self):
+        conf = self._mlp_conf()
+        j = conf.to_json()
+        conf2 = MultiLayerConfiguration.from_json(j)
+        assert conf2.layers == conf.layers
+        assert conf2.input_type == conf.input_type
+        assert conf2.updater == conf.updater
+
+    def test_save_restore_identical_outputs(self):
+        x, y = two_moons(64)
+        model = MultiLayerNetwork(self._mlp_conf()).init()
+        model.fit((x, y), epochs=3)
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "model.zip")
+            save_network(model, p)
+            m2 = restore_network(p)
+        np.testing.assert_allclose(
+            np.asarray(model.output(x)), np.asarray(m2.output(x)), rtol=1e-6
+        )
+        assert m2.iteration == model.iteration
+        # continuing training works (updater state restored)
+        m2.fit((x, y), epochs=1)
+
+    def test_frozen_layer_does_not_update(self):
+        x, y = two_moons(64)
+        conf = MultiLayerConfiguration(
+            layers=(
+                Dense(n_out=8, activation="tanh", trainable=False),
+                OutputLayer(n_out=2, activation="softmax"),
+            ),
+            input_type=InputType.feed_forward(2),
+            updater={"type": "sgd", "lr": 0.1},
+        )
+        model = MultiLayerNetwork(conf).init()
+        w_before = np.asarray(model.params[0]["W"]).copy()
+        model.fit((x, y), epochs=5)
+        np.testing.assert_array_equal(w_before, np.asarray(model.params[0]["W"]))
+        # output layer did move
+        assert not np.allclose(0, np.asarray(model.params[1]["W"]) - 0)
+
+    def test_batchnorm_state_updates(self):
+        x, y = two_moons(64)
+        conf = MultiLayerConfiguration(
+            layers=(
+                Dense(n_out=8, activation="identity"),
+                BatchNorm(),
+                OutputLayer(n_out=2, activation="softmax"),
+            ),
+            input_type=InputType.feed_forward(2),
+            updater={"type": "sgd", "lr": 0.1},
+        )
+        model = MultiLayerNetwork(conf).init()
+        mean_before = np.asarray(model.state[1]["mean"]).copy()
+        model.fit((x, y), epochs=2)
+        assert not np.allclose(mean_before, np.asarray(model.state[1]["mean"]))
+
+    def test_cnn_pipeline(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(16, 8, 8, 1).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 16)]
+        conf = MultiLayerConfiguration(
+            layers=(
+                Conv2D(n_out=4, kernel=(3, 3), activation="relu"),
+                Subsampling2D(kernel=(2, 2), stride=(2, 2)),
+                OutputLayer(n_out=3, activation="softmax"),
+            ),
+            input_type=InputType.convolutional(8, 8, 1),
+            updater={"type": "adam", "lr": 0.01},
+        )
+        model = MultiLayerNetwork(conf).init()
+        s0 = model.score(x, y)
+        model.fit((x, y), epochs=30)
+        assert model.score(x, y) < s0
+        assert model.output(x).shape == (16, 3)
+
+    def test_dropout_train_vs_inference(self):
+        x, _ = two_moons(32)
+        conf = MultiLayerConfiguration(
+            layers=(
+                Dense(n_out=32, activation="tanh"),
+                DropoutLayer(dropout=0.5),
+                OutputLayer(n_out=2, activation="softmax"),
+            ),
+            input_type=InputType.feed_forward(2),
+        )
+        model = MultiLayerNetwork(conf).init()
+        o1 = np.asarray(model.output(x))
+        o2 = np.asarray(model.output(x))
+        np.testing.assert_array_equal(o1, o2)  # inference is deterministic
+
+
+class TestRnnTraining:
+    def _seq_data(self, n=16, t=12, f=3, k=2, seed=0):
+        rs = np.random.RandomState(seed)
+        x = rs.randn(n, t, f).astype(np.float32)
+        # label: sign of running mean of first feature
+        cum = np.cumsum(x[..., 0], axis=1) / np.arange(1, t + 1)
+        lab = (cum > 0).astype(int)
+        y = np.eye(k, dtype=np.float32)[lab]
+        return x, y
+
+    def test_lstm_sequence_classification(self):
+        x, y = self._seq_data()
+        conf = MultiLayerConfiguration(
+            layers=(
+                LSTM(n_out=8),
+                RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+            ),
+            input_type=InputType.recurrent(3, 12),
+            updater={"type": "adam", "lr": 0.02},
+        )
+        model = MultiLayerNetwork(conf).init()
+        s0 = model.score(x, y)
+        model.fit((x, y), epochs=40)
+        assert model.score(x, y) < s0 * 0.8
+
+    def test_tbptt_runs_and_carries(self):
+        x, y = self._seq_data(n=8, t=20)
+        conf = MultiLayerConfiguration(
+            layers=(
+                LSTM(n_out=8),
+                RnnOutputLayer(n_out=2, activation="softmax"),
+            ),
+            input_type=InputType.recurrent(3, 20),
+            updater={"type": "adam", "lr": 0.01},
+            backprop_type="tbptt",
+            tbptt_fwd_length=5,
+        )
+        model = MultiLayerNetwork(conf).init()
+        model.fit((x, y), epochs=2)
+        # 20 timesteps / 5 per chunk = 4 iterations per batch per epoch
+        assert model.iteration == 8
+
+    def test_rnn_time_step_matches_full_forward(self):
+        x, _ = self._seq_data(n=4, t=6)
+        conf = MultiLayerConfiguration(
+            layers=(
+                SimpleRnn(n_out=5),
+                RnnOutputLayer(n_out=2, activation="softmax"),
+            ),
+            input_type=InputType.recurrent(3, 6),
+        )
+        model = MultiLayerNetwork(conf).init()
+        full = np.asarray(model.output(x))
+        model.rnn_clear_previous_state()
+        stepped = []
+        for t in range(x.shape[1]):
+            stepped.append(np.asarray(model.rnn_time_step(x[:, t, :])))
+        stepped = np.stack(stepped, axis=1)
+        np.testing.assert_allclose(full, stepped, rtol=1e-5, atol=1e-6)
+
+
+class TestEvaluation:
+    def test_evaluation_metrics(self):
+        ev = Evaluation(num_classes=2)
+        labels = np.array([[1, 0], [1, 0], [0, 1], [0, 1]])
+        preds = np.array([[0.9, 0.1], [0.4, 0.6], [0.2, 0.8], [0.3, 0.7]])
+        ev.eval(labels, preds)
+        assert ev.accuracy() == pytest.approx(0.75)
+        assert ev.confusion.count(0, 1) == 1
+        assert 0 < ev.f1() <= 1
+        assert "Accuracy" in ev.stats()
+
+    def test_evaluation_merge(self):
+        labels = np.eye(3)[np.array([0, 1, 2, 0])]
+        preds = np.eye(3)[np.array([0, 1, 1, 0])] * 0.9 + 0.05
+        e1, e2, e3 = Evaluation(3), Evaluation(3), Evaluation(3)
+        e1.eval(labels[:2], preds[:2])
+        e2.eval(labels[2:], preds[2:])
+        e3.eval(labels, preds)
+        e1.merge(e2)
+        assert np.array_equal(e1.confusion.matrix, e3.confusion.matrix)
+
+    def test_regression_evaluation(self):
+        ev = RegressionEvaluation()
+        y = np.array([[1.0], [2.0], [3.0]])
+        p = np.array([[1.1], [1.9], [3.2]])
+        ev.eval(y, p)
+        assert ev.mean_squared_error() == pytest.approx(np.mean((y - p) ** 2), rel=1e-6)
+        assert ev.pearson_correlation() > 0.99
+        assert ev.r_squared() > 0.9
+
+    def test_roc_auc_perfect_and_random(self):
+        roc = ROC(num_bins=100)
+        labels = np.array([0, 0, 1, 1])
+        preds = np.array([0.1, 0.2, 0.8, 0.9])
+        roc.eval(labels, preds)
+        assert roc.calculate_auc() == pytest.approx(1.0, abs=0.02)
+        roc2 = ROC(num_bins=0)
+        roc2.eval(labels, preds)
+        assert roc2.calculate_auc() == pytest.approx(1.0, abs=1e-6)
+
+    def test_roc_merge_matches_single(self):
+        rs = np.random.RandomState(0)
+        labels = rs.randint(0, 2, 1000)
+        preds = np.clip(labels * 0.3 + rs.uniform(0, 0.7, 1000), 0, 1)
+        ra, rb, rall = ROC(50), ROC(50), ROC(50)
+        ra.eval(labels[:500], preds[:500])
+        rb.eval(labels[500:], preds[500:])
+        rall.eval(labels, preds)
+        ra.merge(rb)
+        assert ra.calculate_auc() == pytest.approx(rall.calculate_auc(), abs=1e-9)
+
+    def test_roc_multiclass(self):
+        rs = np.random.RandomState(1)
+        labels = rs.randint(0, 3, 300)
+        preds = np.eye(3)[labels] * 0.6 + rs.dirichlet([1, 1, 1], 300) * 0.4
+        roc = ROCMultiClass(100)
+        roc.eval(labels, preds)
+        assert roc.calculate_average_auc() > 0.9
+
+    def test_evaluation_binary(self):
+        ev = EvaluationBinary()
+        labels = np.array([[1, 0], [1, 1], [0, 0], [0, 1]])
+        preds = np.array([[0.9, 0.2], [0.8, 0.4], [0.3, 0.1], [0.2, 0.9]])
+        ev.eval(labels, preds)
+        assert ev.accuracy(0) == 1.0
+        assert ev.recall(1) == pytest.approx(0.5)
+
+    def test_calibration(self):
+        rs = np.random.RandomState(2)
+        p = rs.uniform(0, 1, (2000, 1))
+        labels = (rs.uniform(size=(2000, 1)) < p).astype(float)
+        labels2 = np.concatenate([1 - labels, labels], axis=1)
+        preds = np.concatenate([1 - p, p], axis=1)
+        ec = EvaluationCalibration()
+        ec.eval(labels2, preds)
+        assert ec.expected_calibration_error(1) < 0.05
+
+
+class TestReviewRegressions:
+    """Regressions for code-review findings (round 1)."""
+
+    def test_conv_bn_conv_stack_builds_and_trains(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(8, 8, 8, 1).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 8)]
+        conf = MultiLayerConfiguration(
+            layers=(
+                Conv2D(n_out=4, kernel=(3, 3), activation="relu"),
+                BatchNorm(),
+                Conv2D(n_out=4, kernel=(3, 3), activation="relu"),
+                OutputLayer(n_out=2, activation="softmax"),
+            ),
+            input_type=InputType.convolutional(8, 8, 1),
+            updater={"type": "adam", "lr": 0.01},
+        )
+        model = MultiLayerNetwork(conf).init()
+        # BN must be per-channel (4 channels), not flattened
+        assert model.state[1]["mean"].shape == (4,)
+        model.fit((x, y), epochs=2)
+        assert model.output(x).shape == (8, 2)
+
+    def test_subsampling1d_mask_propagation(self):
+        from deeplearning4j_tpu.nn.layers import Subsampling1D
+
+        rs = np.random.RandomState(1)
+        x = rs.randn(2, 6, 3).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, (2, 3))]
+        mask = np.array([[1, 1, 1, 1, 0, 0], [1, 1, 1, 1, 1, 1]], np.float32)
+        conf = MultiLayerConfiguration(
+            layers=(
+                Subsampling1D(kernel=2, stride=2),
+                LSTM(n_out=4),
+                RnnOutputLayer(n_out=2, activation="softmax"),
+            ),
+            input_type=InputType.recurrent(3, 6),
+        )
+        model = MultiLayerNetwork(conf).init()
+        # must not crash with mismatched scan lengths; mask shrinks 6 -> 3
+        model.fit((x, y, mask), epochs=1)
+
+    def test_wrapped_rnn_l2_counts(self):
+        from deeplearning4j_tpu.nn.layers import Bidirectional
+
+        rs = np.random.RandomState(2)
+        x = rs.randn(2, 4, 3).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 2)]
+        inner = LSTM(n_out=4, l2=0.05)
+        from deeplearning4j_tpu.nn.layers import LastTimeStep
+
+        conf = MultiLayerConfiguration(
+            layers=(
+                LastTimeStep(rnn=inner),
+                OutputLayer(n_out=2, activation="softmax"),
+            ),
+            input_type=InputType.recurrent(3, 4),
+        )
+        model = MultiLayerNetwork(conf).init()
+        pen = float(model.layers[0].regularization_penalty(model.params[0]))
+        assert pen > 0.0  # inner LSTM's l2 is not silently dropped
