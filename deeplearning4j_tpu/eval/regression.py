@@ -48,6 +48,9 @@ class RegressionEvaluation:
             if mask is not None:
                 keep = np.asarray(mask).reshape(-1) > 0
                 labels, predictions = labels[keep], predictions[keep]
+        elif mask is not None:
+            keep = np.asarray(mask).reshape(-1) > 0
+            labels, predictions = labels[keep], predictions[keep]
         if labels.ndim == 1:
             labels = labels[:, None]
             predictions = predictions[:, None]

@@ -39,6 +39,11 @@ class ROC:
         if labels.ndim == 2:
             labels = labels.argmax(axis=-1)
         if predictions.ndim == 2:
+            if predictions.shape[1] > 2:
+                raise ValueError(
+                    f"ROC is binary-only but predictions have {predictions.shape[1]} "
+                    "columns; use ROCMultiClass for multi-class outputs"
+                )
             predictions = predictions[:, 1] if predictions.shape[1] == 2 else predictions[:, 0]
         labels = labels.astype(bool)
         p = np.clip(predictions.astype(np.float64), 0.0, 1.0)
@@ -51,12 +56,12 @@ class ROC:
             self._labels.append(labels)
 
     def _counts(self):
+        """Exact mode: raw concatenated (scores, labels) — callers sort."""
         if self.num_bins > 0:
             return self.pos_hist, self.neg_hist
         scores = np.concatenate(self._scores) if self._scores else np.zeros(0)
         labels = np.concatenate(self._labels) if self._labels else np.zeros(0, bool)
-        order = np.argsort(scores)
-        return scores[order], labels[order]
+        return scores, labels
 
     def roc_curve(self):
         """Returns (fpr, tpr) arrays from highest threshold to lowest."""

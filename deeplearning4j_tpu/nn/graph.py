@@ -1,0 +1,955 @@
+"""ComputationGraph: arbitrary-DAG models (multi-input / multi-output).
+
+Capability parity with the reference's nn/graph/ComputationGraph.java
+(3,902 LoC: vertices:143, topologicalOrder:152, init:377, fit:857-1146,
+calcBackpropGradients:1942, output:1754-1878), the conf classes under
+nn/conf/graph/ (ElementWiseVertex, MergeVertex, StackVertex, UnstackVertex,
+SubsetVertex, ScaleVertex, ShiftVertex, L2Vertex, L2NormalizeVertex,
+ReshapeVertex, PreprocessorVertex, rnn/LastTimeStepVertex,
+rnn/DuplicateToTimeSeriesVertex, rnn/ReverseTimeSeriesVertex) and
+nn/conf/ComputationGraphConfiguration.java — re-designed TPU-first:
+
+- One pure jitted train step over the whole DAG: forward walks the
+  topological order once inside the trace, loss is the sum over all output
+  heads, backward is autodiff of the whole step. The reference instead walks
+  `GraphVertex.doForward/doBackward` objects with per-op JNI dispatch and
+  hand-written epsilon accumulation at fan-in vertices — XLA's autodiff does
+  that accumulation for free.
+- Params are a dict {vertex_name: layer params}, not one flattened view
+  split into per-vertex subsets (ComputationGraph.init:426-470).
+- NHWC / [batch, time, feat] layouts throughout (TPU tiling), so MergeVertex
+  is always a last-axis concat regardless of input kind.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.config import LayerConfig, layer_from_dict, _encode_value
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.preprocessors import infer_preprocessor
+from deeplearning4j_tpu.train.updaters import (
+    apply_gradient_normalization,
+    make_updater,
+    normalize_updater,
+)
+
+# ---------------------------------------------------------------------------
+# Vertex configs
+# ---------------------------------------------------------------------------
+
+vertex_registry: Dict[str, type] = {}
+
+
+def register_vertex(type_name: str):
+    def deco(cls):
+        cls._vtype_name = type_name
+        vertex_registry[type_name] = cls
+        return cls
+
+    return deco
+
+
+@dataclass
+class GraphVertex:
+    """Base for non-layer DAG nodes (nn/conf/graph/GraphVertex.java).
+
+    Contract (all pure; list-valued inputs):
+    - ``output_type(input_types) -> InputType``
+    - ``init(key, input_types, dtype) -> params`` ({} default — most vertices
+      are param-free)
+    - ``apply(params, state, xs, *, train, rng, masks) -> (y, new_state)``
+    - ``propagate_mask(masks, input_types) -> mask``
+    """
+
+    _vtype_name = "vertex"
+    trainable = True
+    l1 = 0.0
+    l2 = 0.0
+    updater = None
+
+    def to_dict(self) -> dict:
+        d = {"@vtype": self._vtype_name}
+        for f in dataclasses.fields(self):
+            d[f.name] = _encode_value(getattr(self, f.name))
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "GraphVertex":
+        tag = d.get("@vtype")
+        if tag not in vertex_registry:
+            raise ValueError(f"Unknown vertex type '{tag}'. Known: {sorted(vertex_registry)}")
+        cls = vertex_registry[tag]
+        names = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: v for k, v in d.items() if k in names}
+        # JSON arrays -> tuples for shape-like fields
+        kwargs = {k: tuple(v) if isinstance(v, list) else v for k, v in kwargs.items()}
+        return cls(**kwargs)
+
+    # -- contract defaults -------------------------------------------------
+    def output_type(self, input_types: List[InputType]) -> InputType:
+        return input_types[0]
+
+    def init(self, key, input_types: List[InputType], dtype=jnp.float32):
+        return {}
+
+    def init_state(self, input_types: List[InputType]):
+        return {}
+
+    def apply(self, params, state, xs: List[jax.Array], *, train=False, rng=None, masks=None):
+        raise NotImplementedError
+
+    def propagate_mask(self, masks, input_types: List[InputType]):
+        for m in masks or ():
+            if m is not None:
+                return m
+        return None
+
+    def regularization_penalty(self, params):
+        return jnp.asarray(0.0, jnp.float32)
+
+
+@register_vertex("merge")
+@dataclass
+class MergeVertex(GraphVertex):
+    """Concat along the feature/channel axis (MergeVertex.java). NHWC makes
+    this the last axis for every input kind."""
+
+    def output_type(self, input_types):
+        it0 = input_types[0]
+        if it0.kind == "conv":
+            return InputType.convolutional(
+                it0.height, it0.width, sum(t.channels for t in input_types)
+            )
+        if it0.kind == "recurrent":
+            return InputType.recurrent(sum(t.size for t in input_types), it0.timesteps)
+        return InputType.feed_forward(sum(t.flat_size() for t in input_types))
+
+    def apply(self, params, state, xs, *, train=False, rng=None, masks=None):
+        return jnp.concatenate(xs, axis=-1), state
+
+
+@register_vertex("elementwise")
+@dataclass
+class ElementWiseVertex(GraphVertex):
+    """Pointwise add/subtract/product/average/max across inputs
+    (ElementWiseVertex.java — the residual-connection workhorse)."""
+
+    op: str = "add"
+
+    def apply(self, params, state, xs, *, train=False, rng=None, masks=None):
+        if self.op == "add":
+            y = sum(xs[1:], xs[0])
+        elif self.op == "subtract":
+            y = xs[0] - xs[1]
+        elif self.op == "product":
+            y = xs[0]
+            for x in xs[1:]:
+                y = y * x
+        elif self.op == "average":
+            y = sum(xs[1:], xs[0]) / len(xs)
+        elif self.op == "max":
+            y = xs[0]
+            for x in xs[1:]:
+                y = jnp.maximum(y, x)
+        else:
+            raise ValueError(f"Unknown elementwise op '{self.op}'")
+        return y, state
+
+
+@register_vertex("stack")
+@dataclass
+class StackVertex(GraphVertex):
+    """Concat along the batch axis (StackVertex.java) — used with Unstack for
+    weight sharing across branches."""
+
+    def apply(self, params, state, xs, *, train=False, rng=None, masks=None):
+        return jnp.concatenate(xs, axis=0), state
+
+
+@register_vertex("unstack")
+@dataclass
+class UnstackVertex(GraphVertex):
+    """Slice batch segment ``from_index`` of ``stack_size`` equal parts
+    (UnstackVertex.java)."""
+
+    from_index: int = 0
+    stack_size: int = 1
+
+    def apply(self, params, state, xs, *, train=False, rng=None, masks=None):
+        x = xs[0]
+        step = x.shape[0] // self.stack_size
+        return x[self.from_index * step : (self.from_index + 1) * step], state
+
+
+@register_vertex("subset")
+@dataclass
+class SubsetVertex(GraphVertex):
+    """Feature range [from_index, to_index] INCLUSIVE (SubsetVertex.java)."""
+
+    from_index: int = 0
+    to_index: int = 0
+
+    def output_type(self, input_types):
+        n = self.to_index - self.from_index + 1
+        it = input_types[0]
+        if it.kind == "recurrent":
+            return InputType.recurrent(n, it.timesteps)
+        if it.kind == "conv":
+            return InputType.convolutional(it.height, it.width, n)
+        return InputType.feed_forward(n)
+
+    def apply(self, params, state, xs, *, train=False, rng=None, masks=None):
+        return xs[0][..., self.from_index : self.to_index + 1], state
+
+
+@register_vertex("scale")
+@dataclass
+class ScaleVertex(GraphVertex):
+    scale: float = 1.0
+
+    def apply(self, params, state, xs, *, train=False, rng=None, masks=None):
+        return xs[0] * self.scale, state
+
+
+@register_vertex("shift")
+@dataclass
+class ShiftVertex(GraphVertex):
+    shift: float = 0.0
+
+    def apply(self, params, state, xs, *, train=False, rng=None, masks=None):
+        return xs[0] + self.shift, state
+
+
+@register_vertex("l2")
+@dataclass
+class L2Vertex(GraphVertex):
+    """Pairwise L2 distance of two inputs -> [batch, 1] (L2Vertex.java, used
+    by triplet-loss nets like FaceNet)."""
+
+    eps: float = 1e-8
+
+    def output_type(self, input_types):
+        return InputType.feed_forward(1)
+
+    def apply(self, params, state, xs, *, train=False, rng=None, masks=None):
+        a = xs[0].reshape(xs[0].shape[0], -1)
+        b = xs[1].reshape(xs[1].shape[0], -1)
+        d = jnp.sqrt(jnp.sum((a - b) ** 2, axis=-1, keepdims=True) + self.eps)
+        return d, state
+
+
+@register_vertex("l2normalize")
+@dataclass
+class L2NormalizeVertex(GraphVertex):
+    """x / ||x||_2 over all non-batch axes (L2NormalizeVertex.java)."""
+
+    eps: float = 1e-8
+
+    def apply(self, params, state, xs, *, train=False, rng=None, masks=None):
+        x = xs[0]
+        axes = tuple(range(1, x.ndim))
+        norm = jnp.sqrt(jnp.sum(x * x, axis=axes, keepdims=True) + self.eps)
+        return x / norm, state
+
+
+@register_vertex("reshape")
+@dataclass
+class ReshapeVertex(GraphVertex):
+    """Reshape to ``shape`` (batch axis = -1 allowed) (ReshapeVertex.java)."""
+
+    shape: Tuple[int, ...] = ()
+    output: Optional[dict] = None  # explicit InputType dict for shape inference
+
+    def output_type(self, input_types):
+        if self.output is not None:
+            return InputType.from_dict(dict(self.output))
+        s = [d for d in self.shape if d != -1]
+        if len(s) == 1:
+            return InputType.feed_forward(s[0])
+        if len(s) == 3:
+            return InputType.convolutional(s[0], s[1], s[2])
+        if len(s) == 2:
+            return InputType.recurrent(s[1], s[0])
+        return input_types[0]
+
+    def apply(self, params, state, xs, *, train=False, rng=None, masks=None):
+        return xs[0].reshape(self.shape), state
+
+
+@register_vertex("preprocessor")
+@dataclass
+class PreprocessorVertex(GraphVertex):
+    """Wraps any param-free LayerConfig (the preprocessors) as a DAG node
+    (PreprocessorVertex.java)."""
+
+    preprocessor: Any = None
+
+    def output_type(self, input_types):
+        return self.preprocessor.output_type(input_types[0])
+
+    def apply(self, params, state, xs, *, train=False, rng=None, masks=None):
+        y, _ = self.preprocessor.apply({}, {}, xs[0], train=train, rng=rng,
+                                       mask=masks[0] if masks else None)
+        return y, state
+
+    def to_dict(self):
+        return {"@vtype": self._vtype_name, "preprocessor": self.preprocessor.to_dict()}
+
+    @staticmethod
+    def _decode(d):
+        return PreprocessorVertex(preprocessor=layer_from_dict(d["preprocessor"]))
+
+
+@register_vertex("last_time_step")
+@dataclass
+class LastTimeStepVertex(GraphVertex):
+    """[b,t,f] -> [b,f]: last time step, or last UNMASKED step when the named
+    network input has a mask (rnn/LastTimeStepVertex.java)."""
+
+    mask_input: Optional[str] = None
+
+    def output_type(self, input_types):
+        return InputType.feed_forward(input_types[0].size)
+
+    def apply(self, params, state, xs, *, train=False, rng=None, masks=None):
+        x = xs[0]
+        m = masks[0] if masks else None
+        if m is None:
+            return x[:, -1, :], state
+        # last index where mask==1 (handles left-padded/ALIGN_END masks)
+        T = x.shape[1]
+        rev = jnp.flip(m > 0, axis=1)
+        idx = (T - 1 - jnp.argmax(rev, axis=1)).astype(jnp.int32)
+        return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0, :], state
+
+    def propagate_mask(self, masks, input_types):
+        return None
+
+
+@register_vertex("duplicate_to_time_series")
+@dataclass
+class DuplicateToTimeSeriesVertex(GraphVertex):
+    """[b,f] -> [b,t,f], t taken from the second runtime input (the reference
+    names a network input; here the builder wires that input's activation in
+    as input #2 so t is known inside the trace)
+    (rnn/DuplicateToTimeSeriesVertex.java)."""
+
+    def output_type(self, input_types):
+        t = input_types[1].timesteps if len(input_types) > 1 else None
+        return InputType.recurrent(input_types[0].flat_size(), t)
+
+    def apply(self, params, state, xs, *, train=False, rng=None, masks=None):
+        x, ref = xs[0], xs[1]
+        return jnp.broadcast_to(x[:, None, :], (x.shape[0], ref.shape[1], x.shape[-1])), state
+
+    def propagate_mask(self, masks, input_types):
+        return masks[1] if masks and len(masks) > 1 else None
+
+
+@register_vertex("reverse_time_series")
+@dataclass
+class ReverseTimeSeriesVertex(GraphVertex):
+    """Reverse the time axis; with a mask, only the valid prefix is reversed
+    (rnn/ReverseTimeSeriesVertex.java)."""
+
+    def apply(self, params, state, xs, *, train=False, rng=None, masks=None):
+        x = xs[0]
+        m = masks[0] if masks else None
+        if m is None:
+            return x[:, ::-1, :], state
+        lengths = jnp.sum(m > 0, axis=1).astype(jnp.int32)  # [b]
+        t = x.shape[1]
+        # index j -> (len-1-j) for j < len, else j (padding stays in place)
+        j = jnp.arange(t)[None, :]
+        idx = jnp.where(j < lengths[:, None], lengths[:, None] - 1 - j, j)
+        return jnp.take_along_axis(x, idx[:, :, None], axis=1), state
+
+
+# ---------------------------------------------------------------------------
+# Configuration + builder
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class VertexSpec:
+    """One DAG node: a LayerConfig or a GraphVertex plus its input names."""
+
+    config: Any
+    inputs: Tuple[str, ...]
+
+    def is_layer(self) -> bool:
+        return isinstance(self.config, LayerConfig)
+
+
+@dataclass
+class ComputationGraphConfiguration:
+    """DAG config (ComputationGraphConfiguration.java, 928 LoC). JSON
+    round-trip is the long-lived artifact contract (SURVEY §5.6)."""
+
+    inputs: Tuple[str, ...] = ()
+    input_types: Dict[str, InputType] = field(default_factory=dict)
+    vertices: Dict[str, VertexSpec] = field(default_factory=dict)  # insertion-ordered
+    outputs: Tuple[str, ...] = ()
+    seed: int = 12345
+    updater: Any = "sgd"
+    dtype: str = "float32"
+
+    # -- serde -------------------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "format": "deeplearning4j_tpu/ComputationGraphConfiguration",
+            "version": 1,
+            "inputs": list(self.inputs),
+            "input_types": {k: v.to_dict() for k, v in self.input_types.items()},
+            "vertices": [
+                {
+                    "name": name,
+                    "inputs": list(spec.inputs),
+                    ("layer" if spec.is_layer() else "vertex"): spec.config.to_dict(),
+                }
+                for name, spec in self.vertices.items()
+            ],
+            "outputs": list(self.outputs),
+            "seed": self.seed,
+            "updater": _encode_value(self.updater),
+            "dtype": self.dtype,
+        }
+
+    def to_json(self, **kw) -> str:
+        return json.dumps(self.to_dict(), **kw)
+
+    @staticmethod
+    def from_dict(d: dict) -> "ComputationGraphConfiguration":
+        vertices: Dict[str, VertexSpec] = {}
+        for v in d["vertices"]:
+            if "layer" in v:
+                cfg = layer_from_dict(v["layer"])
+            elif v["vertex"].get("@vtype") == "preprocessor":
+                cfg = PreprocessorVertex._decode(v["vertex"])
+            else:
+                cfg = GraphVertex.from_dict(v["vertex"])
+            vertices[v["name"]] = VertexSpec(cfg, tuple(v["inputs"]))
+        return ComputationGraphConfiguration(
+            inputs=tuple(d["inputs"]),
+            input_types={k: InputType.from_dict(t) for k, t in d["input_types"].items()},
+            vertices=vertices,
+            outputs=tuple(d["outputs"]),
+            seed=d.get("seed", 12345),
+            updater=d.get("updater", "sgd"),
+            dtype=d.get("dtype", "float32"),
+        )
+
+    @staticmethod
+    def from_json(s: str) -> "ComputationGraphConfiguration":
+        return ComputationGraphConfiguration.from_dict(json.loads(s))
+
+    @staticmethod
+    def builder() -> "GraphBuilder":
+        return GraphBuilder()
+
+
+class GraphBuilder:
+    """Fluent DAG builder (ComputationGraphConfiguration.GraphBuilder)."""
+
+    def __init__(self):
+        self._inputs: List[str] = []
+        self._input_types: Dict[str, InputType] = {}
+        self._vertices: Dict[str, VertexSpec] = {}
+        self._outputs: List[str] = []
+        self._seed = 12345
+        self._updater: Any = "sgd"
+        self._dtype = "float32"
+
+    def add_inputs(self, *names: str) -> "GraphBuilder":
+        self._inputs.extend(names)
+        return self
+
+    def set_input_types(self, *types: InputType) -> "GraphBuilder":
+        if len(types) != len(self._inputs):
+            raise ValueError("set_input_types: one InputType per declared input")
+        self._input_types = dict(zip(self._inputs, types))
+        return self
+
+    def add_layer(self, name: str, layer: LayerConfig, *inputs: str) -> "GraphBuilder":
+        return self.add_vertex(name, layer, *inputs)
+
+    def add_vertex(self, name: str, v: Any, *inputs: str) -> "GraphBuilder":
+        if name in self._vertices or name in self._inputs:
+            raise ValueError(f"Duplicate vertex name '{name}'")
+        known = set(self._inputs) | set(self._vertices)
+        for i in inputs:
+            if i not in known:
+                raise ValueError(f"Vertex '{name}' input '{i}' is not defined (yet)")
+        self._vertices[name] = VertexSpec(v, tuple(inputs))
+        return self
+
+    def set_outputs(self, *names: str) -> "GraphBuilder":
+        self._outputs = list(names)
+        return self
+
+    def seed(self, s: int) -> "GraphBuilder":
+        self._seed = s
+        return self
+
+    def updater(self, u: Any) -> "GraphBuilder":
+        self._updater = u
+        return self
+
+    def dtype(self, d: str) -> "GraphBuilder":
+        self._dtype = d
+        return self
+
+    def build(self) -> ComputationGraphConfiguration:
+        if not self._inputs:
+            raise ValueError("ComputationGraph needs at least one input")
+        if not self._outputs:
+            raise ValueError("ComputationGraph needs at least one output")
+        for o in self._outputs:
+            if o not in self._vertices:
+                raise ValueError(f"Output '{o}' is not a vertex")
+        if set(self._input_types) != set(self._inputs):
+            raise ValueError("set_input_types is required (one per input)")
+        return ComputationGraphConfiguration(
+            inputs=tuple(self._inputs),
+            input_types=self._input_types,
+            vertices=self._vertices,
+            outputs=tuple(self._outputs),
+            seed=self._seed,
+            updater=self._updater,
+            dtype=self._dtype,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Runtime model
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _RuntimeVertex:
+    name: str
+    spec: VertexSpec
+    inputs: Tuple[str, ...]
+    pre: Optional[LayerConfig]          # auto-inserted preprocessor (layer vertices)
+    input_types: List[InputType]        # per runtime input, post-preprocessor
+    out_type: InputType
+    config: Any                          # resolved (n_in inferred) layer/vertex
+
+
+def _toposort(conf: ComputationGraphConfiguration) -> List[str]:
+    """Kahn's algorithm over vertex names (ComputationGraph.topologicalOrder
+    equivalent, computed once at build)."""
+    indeg = {n: 0 for n in conf.vertices}
+    dependents: Dict[str, List[str]] = {n: [] for n in conf.vertices}
+    for name, spec in conf.vertices.items():
+        for i in spec.inputs:
+            if i in conf.vertices:
+                indeg[name] += 1
+                dependents[i].append(name)
+    ready = [n for n, d in indeg.items() if d == 0]
+    order: List[str] = []
+    while ready:
+        n = ready.pop(0)
+        order.append(n)
+        for m in dependents[n]:
+            indeg[m] -= 1
+            if indeg[m] == 0:
+                ready.append(m)
+    if len(order) != len(conf.vertices):
+        cyc = sorted(set(conf.vertices) - set(order))
+        raise ValueError(f"Graph has a cycle involving: {cyc}")
+    return order
+
+
+class ComputationGraph:
+    """Stateful facade over pure jitted DAG functions; API mirrors the
+    reference ComputationGraph (init/fit/output/score/evaluate)."""
+
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.dtype = jnp.dtype(conf.dtype)
+        self._resolve()
+        self.params: Optional[dict] = None
+        self.state: Optional[dict] = None
+        self.opt_state: Optional[dict] = None
+        self.iteration = 0
+        self.epoch = 0
+        self._rng = jax.random.PRNGKey(conf.seed)
+        self._step_fn = None
+        self._output_fn = None
+        self.listeners: list = []
+
+    # -- resolution --------------------------------------------------------
+    def _resolve(self):
+        conf = self.conf
+        self.topo_order = _toposort(conf)
+        types: Dict[str, InputType] = dict(conf.input_types)
+        self.rt: Dict[str, _RuntimeVertex] = {}
+        for name in self.topo_order:
+            spec = conf.vertices[name]
+            in_types = [types[i] for i in spec.inputs]
+            pre = None
+            cfg = spec.config
+            if spec.is_layer():
+                if len(spec.inputs) != 1:
+                    raise ValueError(f"Layer vertex '{name}' must have exactly one input")
+                pre = infer_preprocessor(in_types[0], cfg)
+                if pre is not None:
+                    in_types = [pre.output_type(in_types[0])]
+                if hasattr(cfg, "with_n_in"):
+                    cfg = cfg.with_n_in(cfg.infer_n_in(in_types[0]))
+                out_t = cfg.output_type(in_types[0])
+            else:
+                out_t = cfg.output_type(in_types)
+            types[name] = out_t
+            self.rt[name] = _RuntimeVertex(
+                name=name, spec=spec, inputs=spec.inputs, pre=pre,
+                input_types=in_types, out_type=out_t, config=cfg,
+            )
+        self.vertex_types = types
+        self.output_types = [types[o] for o in conf.outputs]
+        self._loss_vertices = [
+            o for o in conf.outputs if hasattr(self.rt[o].config, "score")
+        ]
+        if not self._loss_vertices:
+            self._loss_vertices = []  # inference-only graph is allowed
+
+    # -- init --------------------------------------------------------------
+    def init(self, seed: Optional[int] = None) -> "ComputationGraph":
+        key = jax.random.PRNGKey(self.conf.seed if seed is None else seed)
+        keys = jax.random.split(key, max(len(self.topo_order), 1))
+        self.params, self.state = {}, {}
+        for k, name in zip(keys, self.topo_order):
+            v = self.rt[name]
+            if v.spec.is_layer():
+                self.params[name] = v.config.init(k, v.input_types[0], self.dtype)
+                self.state[name] = v.config.init_state(v.input_types[0])
+            else:
+                self.params[name] = v.config.init(k, v.input_types, self.dtype)
+                self.state[name] = v.config.init_state(v.input_types)
+        self._build_updaters()
+        self.opt_state = {
+            name: u.init(self.params[name]) for name, u in self._updaters.items()
+        }
+        self.iteration = 0
+        self.epoch = 0
+        return self
+
+    def _build_updaters(self):
+        default = normalize_updater(self.conf.updater)
+        self._updaters = {}
+        for name in self.topo_order:
+            cfg = self.rt[name].config
+            if not getattr(cfg, "trainable", True):
+                self._updaters[name] = make_updater("noop")
+            elif getattr(cfg, "updater", None) is not None:
+                self._updaters[name] = make_updater(cfg.updater)
+            else:
+                self._updaters[name] = make_updater(default)
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(self.params))
+
+    # -- forward -----------------------------------------------------------
+    def _forward(self, params, state, inputs: Dict[str, jax.Array], *, train, rngs,
+                 masks: Optional[Dict[str, Any]] = None, stop_at: Optional[set] = None,
+                 collect: bool = False):
+        """Walk topo order. Returns (acts, new_state, mask_acts).
+
+        ``stop_at``: vertex names whose activation should be the PRE-output
+        value for loss heads — loss vertices are applied outside (score needs
+        the pre-activation input, mirroring MLN's upto=n-1 walk).
+        """
+        acts: Dict[str, jax.Array] = dict(inputs)
+        mask_acts: Dict[str, Any] = dict(masks or {})
+        for n in self.conf.inputs:
+            mask_acts.setdefault(n, None)
+        new_state = {}
+        for i, name in enumerate(self.topo_order):
+            v = self.rt[name]
+            xs = [acts[i_] for i_ in v.inputs]
+            in_masks = [mask_acts.get(i_) for i_ in v.inputs]
+            rng = rngs[i] if rngs is not None else None
+            if stop_at and name in stop_at:
+                # loss head: keep the input activation (post-preprocessor)
+                x = xs[0]
+                m = in_masks[0]
+                if v.pre is not None:
+                    x, _ = v.pre.apply({}, {}, x, train=train, rng=None, mask=m)
+                    m = v.pre.propagate_mask(m, self.vertex_types[v.inputs[0]])
+                acts[name] = x
+                mask_acts[name] = m
+                new_state[name] = state[name]
+                continue
+            if v.spec.is_layer():
+                x, m = xs[0], in_masks[0]
+                it = self.vertex_types[v.inputs[0]] if v.inputs[0] in self.vertex_types \
+                    else self.conf.input_types[v.inputs[0]]
+                if v.pre is not None:
+                    x, _ = v.pre.apply({}, {}, x, train=train, rng=None, mask=m)
+                    m = v.pre.propagate_mask(m, it)
+                    it = v.input_types[0]
+                y, ns = v.config.apply(params[name], state[name], x,
+                                       train=train, rng=rng, mask=m)
+                mask_acts[name] = v.config.propagate_mask(m, it)
+            else:
+                y, ns = v.config.apply(params[name], state[name], xs,
+                                       train=train, rng=rng, masks=in_masks)
+                mask_acts[name] = v.config.propagate_mask(in_masks, v.input_types)
+            acts[name] = y
+            new_state[name] = ns
+        return acts, new_state, mask_acts
+
+    # -- loss --------------------------------------------------------------
+    def _loss(self, params, state, inputs, labels, fmasks, lmasks, rngs, train=True):
+        stop = set(self._loss_vertices)
+        acts, new_state, mask_acts = self._forward(
+            params, state, inputs, train=train, rngs=rngs, masks=fmasks, stop_at=stop
+        )
+        total = jnp.asarray(0.0, jnp.float32)
+        for i, oname in enumerate(self.conf.outputs):
+            if oname not in stop:
+                continue
+            v = self.rt[oname]
+            y = labels[i] if isinstance(labels, (tuple, list)) else labels
+            lm = None
+            if lmasks is not None:
+                lm = lmasks[i] if isinstance(lmasks, (tuple, list)) else lmasks
+            if lm is None:
+                lm = mask_acts.get(oname)
+            total = total + v.config.score(params[oname], acts[oname], y, mask=lm, average=True)
+        for name in self.topo_order:
+            v = self.rt[name]
+            total = total + v.config.regularization_penalty(params[name])
+        return total, new_state
+
+    # -- jitted step -------------------------------------------------------
+    def _make_step(self):
+        order = self.topo_order
+        updaters = self._updaters
+
+        def step(params, opt_state, state, it, rng, inputs, labels, fmasks, lmasks):
+            rngs = list(jax.random.split(rng, len(order)))
+
+            def loss_fn(p):
+                return self._loss(p, state, inputs, labels, fmasks, lmasks, rngs)
+
+            (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            new_params, new_opt = {}, {}
+            for name in order:
+                g = grads[name]
+                if not g:
+                    new_params[name] = params[name]
+                    new_opt[name] = opt_state[name]
+                    continue
+                cfg = self.rt[name].config
+                gn = getattr(cfg, "gradient_normalization", None)
+                if gn:
+                    g = apply_gradient_normalization(
+                        gn, getattr(cfg, "gradient_normalization_threshold", 1.0), g
+                    )
+                upd, ns = updaters[name].update(g, opt_state[name], params[name], it)
+                new_params[name] = jax.tree_util.tree_map(
+                    lambda p, d: p - d, params[name], upd
+                )
+                new_opt[name] = ns
+            return new_params, new_opt, new_state, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    # -- data normalization ------------------------------------------------
+    def _norm_multi(self, v, n) -> Optional[Tuple]:
+        """Normalize features/labels/masks to an n-tuple of arrays (or None)."""
+        if v is None:
+            return None
+        if isinstance(v, (tuple, list)):
+            return tuple(
+                jnp.asarray(x, self.dtype) if x is not None else None for x in v
+            )
+        return (jnp.asarray(v, self.dtype),) + (None,) * (n - 1) if n > 1 else (
+            jnp.asarray(v, self.dtype),
+        )
+
+    def _as_multi_batch(self, batch):
+        """Accept (x, y), (x, y, fmask, lmask) with array-or-tuple members, or
+        a dict — the MultiDataSet surface."""
+        if isinstance(batch, dict):
+            f, l = batch["features"], batch.get("labels")
+            fm, lm = batch.get("features_mask"), batch.get("labels_mask")
+        else:
+            f = batch[0]
+            l = batch[1] if len(batch) > 1 else None
+            fm = batch[2] if len(batch) > 2 else None
+            lm = batch[3] if len(batch) > 3 else None
+        ni, no = len(self.conf.inputs), len(self.conf.outputs)
+        return (
+            self._norm_multi(f, ni),
+            self._norm_multi(l, no),
+            self._norm_multi(fm, ni),
+            self._norm_multi(lm, no),
+        )
+
+    def _input_dict(self, features: Tuple) -> Dict[str, jax.Array]:
+        return dict(zip(self.conf.inputs, features))
+
+    def _mask_dict(self, fmasks: Optional[Tuple]) -> Optional[Dict[str, Any]]:
+        if fmasks is None:
+            return None
+        return dict(zip(self.conf.inputs, fmasks))
+
+    # -- training ----------------------------------------------------------
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None):
+        """Train on a MultiDataSet batch, an iterable of batches, or a
+        callable returning a fresh iterable per epoch."""
+        if self.params is None:
+            self.init()
+        for _ in range(epochs):
+            for l in self.listeners:
+                l.on_epoch_start(self, self.epoch)
+            source = data() if callable(data) else data
+            for batch in self._iter_multi(source, batch_size):
+                score = self.fit_batch(batch)
+                if self.listeners:
+                    score = float(score)
+                    bs = len(jax.tree_util.tree_leaves(batch[0])[0])
+                    for l in self.listeners:
+                        l.iteration_done(self, self.iteration, score, bs)
+            for l in self.listeners:
+                l.on_epoch_end(self, self.epoch)
+            self.epoch += 1
+        return self
+
+    def _iter_multi(self, data, batch_size):
+        """Yield MultiDataSet batches. A bare (features, labels) pair of
+        arrays/tuples is minibatched when batch_size is given.
+
+        Disambiguation (single batch vs iterable of batches) uses the model's
+        input arity: a single batch's features must be one array (1-input
+        nets) or a tuple of exactly len(inputs) arrays."""
+        def _is_arr(v):
+            return isinstance(v, (np.ndarray, jax.Array)) or hasattr(v, "__array__")
+
+        ni = len(self.conf.inputs)
+
+        def _features_like(f):
+            if _is_arr(f):
+                return ni == 1
+            return (
+                isinstance(f, (tuple, list))
+                and len(f) == ni
+                and all(_is_arr(e) for e in f)
+            )
+
+        if isinstance(data, (tuple, list)) and 2 <= len(data) <= 4 and _features_like(data[0]):
+            f, l, fm, lm = self._as_multi_batch(data)
+            n = f[0].shape[0]
+            if batch_size is None or batch_size >= n:
+                yield (f, l, fm, lm)
+                return
+            sl_t = lambda t, s: tuple(x[s] if x is not None else None for x in t) if t else None
+            for i in range(0, n, batch_size):
+                s = slice(i, min(i + batch_size, n))
+                yield (sl_t(f, s), sl_t(l, s), sl_t(fm, s), sl_t(lm, s))
+            return
+        for b in data:
+            yield self._as_multi_batch(b)
+
+    def fit_batch(self, batch):
+        """One jitted step on one (already normalized or raw) batch."""
+        if isinstance(batch, tuple) and len(batch) == 4 and isinstance(batch[0], tuple) \
+                and all(x is None or isinstance(x, (jax.Array, np.ndarray))
+                        for x in batch[0]):
+            f, l, fm, lm = batch
+        else:
+            f, l, fm, lm = self._as_multi_batch(batch)
+        if self._step_fn is None:
+            self._step_fn = self._make_step()
+        self.params, self.opt_state, self.state, loss = self._step_fn(
+            self.params, self.opt_state, self.state,
+            jnp.asarray(self.iteration, jnp.int32), self._next_rng(),
+            self._input_dict(f), l, self._mask_dict(fm), lm,
+        )
+        self.iteration += 1
+        return loss
+
+    def _next_rng(self):
+        self._rng, k = jax.random.split(self._rng)
+        return k
+
+    # -- inference ---------------------------------------------------------
+    def output(self, *xs, fmasks=None):
+        """Outputs of all output vertices (ComputationGraph.output:1754).
+        Returns a single array when the graph has one output."""
+        if len(xs) == 1 and isinstance(xs[0], (tuple, list)):
+            xs = tuple(xs[0])
+        feats = tuple(jnp.asarray(x, self.dtype) for x in xs)
+        fm = self._norm_multi(fmasks, len(self.conf.inputs)) if fmasks is not None else None
+        if self._output_fn is None:
+            def fwd(params, state, inputs, masks):
+                acts, _, _ = self._forward(params, state, inputs, train=False,
+                                           rngs=None, masks=masks)
+                return tuple(acts[o] for o in self.conf.outputs)
+
+            self._output_fn = jax.jit(fwd)
+        outs = self._output_fn(self.params, self.state, self._input_dict(feats),
+                               self._mask_dict(fm))
+        return outs[0] if len(outs) == 1 else outs
+
+    def score(self, batch) -> float:
+        f, l, fm, lm = self._as_multi_batch(batch)
+        loss, _ = self._loss(self.params, self.state, self._input_dict(f), l,
+                             self._mask_dict(fm), lm, rngs=None, train=False)
+        return float(loss)
+
+    def evaluate(self, data, batch_size: Optional[int] = None, top_n: int = 1):
+        """Single-output classification evaluation."""
+        from deeplearning4j_tpu.eval import Evaluation
+
+        ev = Evaluation(top_n=top_n)
+        for f, l, fm, lm in self._iter_multi(data, batch_size):
+            preds = self.output(*f, fmasks=fm)
+            y = l[0] if isinstance(l, tuple) else l
+            m = lm[0] if isinstance(lm, (tuple, list)) and lm else None
+            ev.eval(np.asarray(y), np.asarray(preds), mask=np.asarray(m) if m is not None else None)
+        return ev
+
+    # -- misc --------------------------------------------------------------
+    def clone(self) -> "ComputationGraph":
+        m = ComputationGraph(self.conf)
+        if self.params is not None:
+            m.init()
+            copy = lambda t: jax.tree_util.tree_map(jnp.copy, t)
+            m.params = copy(self.params)
+            m.state = copy(self.state)
+            m.opt_state = copy(self.opt_state)
+            m.iteration = self.iteration
+            m.epoch = self.epoch
+        return m
+
+    def summary(self) -> str:
+        lines = [f"{'name':<24} {'type':<24} {'inputs':<30} {'output':<22} {'params':<10}"]
+        for name in self.topo_order:
+            v = self.rt[name]
+            tname = getattr(v.config, "_type_name", getattr(v.config, "_vtype_name", "?"))
+            n = (
+                sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(self.params[name]))
+                if self.params is not None else "?"
+            )
+            lines.append(
+                f"{name:<24} {tname:<24} {','.join(v.inputs)[:30]:<30} "
+                f"{str(v.out_type.batch_shape())[:22]:<22} {n:<10}"
+            )
+        lines.append(f"Total params: {self.num_params() if self.params is not None else '?'}")
+        return "\n".join(lines)

@@ -525,7 +525,6 @@ class MultiLayerNetwork:
 
     def summary(self) -> str:
         lines = [f"{'idx':<4} {'type':<22} {'output':<24} {'params':<10}"]
-        it_in = None
         for i, (l, it) in enumerate(zip(self.layers, self.layer_input_types)):
             out = l.output_type(it)
             n = (

@@ -47,6 +47,8 @@ class ParallelInference:
 
     # -- public ------------------------------------------------------------
     def output(self, x) -> np.ndarray:
+        if self._stop.is_set():
+            raise RuntimeError("ParallelInference is shut down")
         x = np.asarray(x)
         if self.mode != "batched" or self._thread is None:
             return np.asarray(self.model.output(x))
@@ -62,6 +64,15 @@ class ParallelInference:
         if self._thread is not None:
             self._queue.put(_Pending(None))  # wake the worker
             self._thread.join(timeout=5)
+            # fail any requests stranded in the queue so waiters don't hang
+            while True:
+                try:
+                    p = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if p.x is not None:
+                    p.result = RuntimeError("ParallelInference shut down")
+                    p.event.set()
 
     # -- worker ------------------------------------------------------------
     def _drain(self) -> List[_Pending]:

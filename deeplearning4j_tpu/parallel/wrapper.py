@@ -77,13 +77,20 @@ class ParallelWrapper:
                     # pad to a shardable batch (masked examples would be
                     # better; DL4J just sends uneven batches to workers)
                     pad = self.n_data - n % self.n_data
-                    x = np.concatenate([np.asarray(x), np.asarray(x)[:pad]])
+                    # tile so any n reaches the next multiple of n_data (a
+                    # slice x[:pad] is short when pad > n)
+                    def _pad(a):
+                        a = np.asarray(a)
+                        reps = np.concatenate([a] * (pad // n + 1))[:pad]
+                        return np.concatenate([a, reps])
+
+                    x = _pad(x)
                     if y is not None:
-                        y = np.concatenate([np.asarray(y), np.asarray(y)[:pad]])
+                        y = _pad(y)
                     if fm is not None:
-                        fm = np.concatenate([np.asarray(fm), np.asarray(fm)[:pad]])
+                        fm = _pad(fm)
                     if lm is not None:
-                        lm = np.concatenate([np.asarray(lm), np.asarray(lm)[:pad]])
+                        lm = _pad(lm)
                 score = model._fit_batch(
                     self._shard(x), self._shard(y), self._shard(fm), self._shard(lm)
                 )

@@ -1,0 +1,347 @@
+"""ComputationGraph tests: vertices, topology, training, serde.
+
+Mirrors the reference's TestComputationGraphNetwork /
+GradientCheckTestsComputationGraph coverage (SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.graph import (
+    ComputationGraph,
+    ComputationGraphConfiguration,
+    DuplicateToTimeSeriesVertex,
+    ElementWiseVertex,
+    L2NormalizeVertex,
+    L2Vertex,
+    LastTimeStepVertex,
+    MergeVertex,
+    ReverseTimeSeriesVertex,
+    ScaleVertex,
+    ShiftVertex,
+    StackVertex,
+    SubsetVertex,
+    UnstackVertex,
+)
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers.core import Dense, OutputLayer
+from deeplearning4j_tpu.nn.layers.recurrent import LSTM, RnnOutputLayer
+from deeplearning4j_tpu.utils.gradientcheck import check_gradients
+from deeplearning4j_tpu.utils.serialization import restore_network, save_network
+
+
+def _simple_graph(updater="sgd"):
+    return (
+        ComputationGraphConfiguration.builder()
+        .add_inputs("in")
+        .set_input_types(InputType.feed_forward(4))
+        .add_layer("h", Dense(n_out=8, activation="tanh"), "in")
+        .add_layer("out", OutputLayer(n_out=3, activation="softmax", loss="mcxent"), "h")
+        .set_outputs("out")
+        .updater(updater)
+        .build()
+    )
+
+
+def _iris_like(rng, n=64):
+    """Learnable synthetic data: class = argmax of a fixed linear map."""
+    x = rng.rand(n, 4).astype(np.float32)
+    w = np.linspace(-1, 1, 12).reshape(4, 3)
+    y = np.eye(3, dtype=np.float32)[(x @ w).argmax(-1)]
+    return x, y
+
+
+class TestBasics:
+    def test_fit_reduces_loss(self, rng):
+        x, y = _iris_like(rng)
+        model = ComputationGraph(_simple_graph(updater={"type": "adam", "lr": 0.05})).init()
+        s0 = model.score((x, y))
+        model.fit((x, y), epochs=30)
+        s1 = model.score((x, y))
+        assert s1 < s0 * 0.7
+
+    def test_output_shape_and_softmax(self, rng):
+        x, y = _iris_like(rng)
+        model = ComputationGraph(_simple_graph()).init()
+        out = model.output(x)
+        assert out.shape == (64, 3)
+        np.testing.assert_allclose(np.asarray(out).sum(-1), 1.0, atol=1e-5)
+
+    def test_evaluate(self, rng):
+        x, y = _iris_like(rng)
+        model = ComputationGraph(_simple_graph(updater={"type": "adam", "lr": 0.05})).init()
+        model.fit((x, y), epochs=50)
+        ev = model.evaluate((x, y))
+        assert ev.accuracy() > 0.5
+
+    def test_summary_and_num_params(self):
+        model = ComputationGraph(_simple_graph()).init()
+        assert model.num_params() == 4 * 8 + 8 + 8 * 3 + 3
+        assert "Total params" in model.summary()
+
+    def test_cycle_detection(self):
+        conf = _simple_graph()
+        conf.vertices["h"] = type(conf.vertices["h"])(conf.vertices["h"].config, ("out",))
+        with pytest.raises(ValueError, match="cycle"):
+            ComputationGraph(conf)
+
+
+class TestMultiInputOutput:
+    def _two_in_graph(self):
+        return (
+            ComputationGraphConfiguration.builder()
+            .add_inputs("a", "b")
+            .set_input_types(InputType.feed_forward(3), InputType.feed_forward(5))
+            .add_layer("da", Dense(n_out=6, activation="relu"), "a")
+            .add_layer("db", Dense(n_out=6, activation="relu"), "b")
+            .add_vertex("merge", MergeVertex(), "da", "db")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax"), "merge")
+            .set_outputs("out")
+            .updater({"type": "adam", "lr": 0.05})
+            .build()
+        )
+
+    def test_two_inputs(self, rng):
+        xa = rng.rand(32, 3).astype(np.float32)
+        xb = rng.rand(32, 5).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 32)]
+        model = ComputationGraph(self._two_in_graph()).init()
+        s0 = model.score(((xa, xb), y))
+        model.fit(((xa, xb), y), epochs=40)
+        assert model.score(((xa, xb), y)) < s0
+        out = model.output(xa, xb)
+        assert out.shape == (32, 2)
+
+    def test_two_outputs_loss_sums(self, rng):
+        conf = (
+            ComputationGraphConfiguration.builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(4))
+            .add_layer("h", Dense(n_out=8, activation="tanh"), "in")
+            .add_layer("o1", OutputLayer(n_out=3, activation="softmax"), "h")
+            .add_layer("o2", OutputLayer(n_out=2, activation="softmax"), "h")
+            .set_outputs("o1", "o2")
+            .updater({"type": "adam", "lr": 0.05})
+            .build()
+        )
+        x = rng.rand(16, 4).astype(np.float32)
+        y1 = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)]
+        y2 = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)]
+        model = ComputationGraph(conf).init()
+        s0 = model.score((x, (y1, y2)))
+        model.fit((x, (y1, y2)), epochs=30)
+        assert model.score((x, (y1, y2))) < s0
+        o1, o2 = model.output(x)
+        assert o1.shape == (16, 3) and o2.shape == (16, 2)
+
+
+class TestVertices:
+    def test_elementwise_residual(self, rng):
+        conf = (
+            ComputationGraphConfiguration.builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(6))
+            .add_layer("d", Dense(n_out=6, activation="relu"), "in")
+            .add_vertex("res", ElementWiseVertex(op="add"), "d", "in")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax"), "res")
+            .set_outputs("out")
+            .build()
+        )
+        model = ComputationGraph(conf).init()
+        x = rng.rand(8, 6).astype(np.float32)
+        assert model.output(x).shape == (8, 2)
+
+    @pytest.mark.parametrize("op,fn", [
+        ("add", lambda a, b: a + b),
+        ("subtract", lambda a, b: a - b),
+        ("product", lambda a, b: a * b),
+        ("average", lambda a, b: (a + b) / 2),
+        ("max", np.maximum),
+    ])
+    def test_elementwise_ops(self, op, fn, rng):
+        a = rng.randn(4, 5).astype(np.float32)
+        b = rng.randn(4, 5).astype(np.float32)
+        v = ElementWiseVertex(op=op)
+        y, _ = v.apply({}, {}, [jnp.asarray(a), jnp.asarray(b)])
+        np.testing.assert_allclose(np.asarray(y), fn(a, b), rtol=1e-6)
+
+    def test_stack_unstack(self, rng):
+        a = rng.randn(4, 5).astype(np.float32)
+        b = rng.randn(4, 5).astype(np.float32)
+        stacked, _ = StackVertex().apply({}, {}, [jnp.asarray(a), jnp.asarray(b)])
+        assert stacked.shape == (8, 5)
+        part1, _ = UnstackVertex(from_index=1, stack_size=2).apply({}, {}, [stacked])
+        np.testing.assert_allclose(np.asarray(part1), b)
+
+    def test_subset_inclusive(self, rng):
+        x = rng.randn(3, 10).astype(np.float32)
+        y, _ = SubsetVertex(from_index=2, to_index=5).apply({}, {}, [jnp.asarray(x)])
+        np.testing.assert_allclose(np.asarray(y), x[:, 2:6])
+        assert SubsetVertex(from_index=2, to_index=5).output_type(
+            [InputType.feed_forward(10)]
+        ).size == 4
+
+    def test_scale_shift(self, rng):
+        x = rng.randn(3, 4).astype(np.float32)
+        y, _ = ScaleVertex(scale=2.5).apply({}, {}, [jnp.asarray(x)])
+        np.testing.assert_allclose(np.asarray(y), x * 2.5, rtol=1e-6)
+        y, _ = ShiftVertex(shift=1.5).apply({}, {}, [jnp.asarray(x)])
+        np.testing.assert_allclose(np.asarray(y), x + 1.5, rtol=1e-6)
+
+    def test_l2_vertex(self, rng):
+        a = rng.randn(6, 8).astype(np.float32)
+        b = rng.randn(6, 8).astype(np.float32)
+        y, _ = L2Vertex().apply({}, {}, [jnp.asarray(a), jnp.asarray(b)])
+        expect = np.sqrt(((a - b) ** 2).sum(-1, keepdims=True) + 1e-8)
+        np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-5)
+
+    def test_l2_normalize(self, rng):
+        x = rng.randn(6, 8).astype(np.float32)
+        y, _ = L2NormalizeVertex().apply({}, {}, [jnp.asarray(x)])
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(y), axis=-1), 1.0, atol=1e-4
+        )
+
+
+class TestRnnVertices:
+    def test_last_time_step_masked(self, rng):
+        x = rng.randn(3, 5, 4).astype(np.float32)
+        mask = np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1], [1, 0, 0, 0, 0]], np.float32)
+        v = LastTimeStepVertex()
+        y, _ = v.apply({}, {}, [jnp.asarray(x)], masks=[jnp.asarray(mask)])
+        np.testing.assert_allclose(np.asarray(y)[0], x[0, 2], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(y)[1], x[1, 4], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(y)[2], x[2, 0], rtol=1e-6)
+
+    def test_reverse_time_series_masked(self, rng):
+        x = rng.randn(2, 4, 3).astype(np.float32)
+        mask = np.array([[1, 1, 1, 0], [1, 1, 1, 1]], np.float32)
+        y, _ = ReverseTimeSeriesVertex().apply(
+            {}, {}, [jnp.asarray(x)], masks=[jnp.asarray(mask)]
+        )
+        y = np.asarray(y)
+        np.testing.assert_allclose(y[0, :3], x[0, 2::-1], rtol=1e-6)  # prefix reversed
+        np.testing.assert_allclose(y[0, 3], x[0, 3], rtol=1e-6)      # padding in place
+        np.testing.assert_allclose(y[1], x[1, ::-1], rtol=1e-6)
+
+    def test_duplicate_to_time_series(self, rng):
+        ff = rng.randn(3, 4).astype(np.float32)
+        ref = rng.randn(3, 7, 2).astype(np.float32)
+        y, _ = DuplicateToTimeSeriesVertex().apply({}, {}, [jnp.asarray(ff), jnp.asarray(ref)])
+        assert y.shape == (3, 7, 4)
+        np.testing.assert_allclose(np.asarray(y)[:, 3], ff, rtol=1e-6)
+
+    def test_seq2seq_style_graph(self, rng):
+        """Encoder LSTM -> last step -> duplicate over decoder input timesteps
+        -> merge with decoder input -> LSTM -> rnn output (the reference's
+        canonical seq2seq wiring with DuplicateToTimeSeriesVertex)."""
+        conf = (
+            ComputationGraphConfiguration.builder()
+            .add_inputs("enc_in", "dec_in")
+            .set_input_types(InputType.recurrent(5), InputType.recurrent(3))
+            .add_layer("enc", LSTM(n_out=8, activation="tanh"), "enc_in")
+            .add_vertex("last", LastTimeStepVertex(), "enc")
+            .add_vertex("dup", DuplicateToTimeSeriesVertex(), "last", "dec_in")
+            .add_vertex("merge", MergeVertex(), "dec_in", "dup")
+            .add_layer("dec", LSTM(n_out=8, activation="tanh"), "merge")
+            .add_layer("out", RnnOutputLayer(n_out=4, activation="softmax"), "dec")
+            .set_outputs("out")
+            .updater({"type": "adam", "lr": 0.02})
+            .build()
+        )
+        model = ComputationGraph(conf).init()
+        enc = rng.rand(6, 9, 5).astype(np.float32)
+        dec = rng.rand(6, 7, 3).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, (6, 7))]
+        s0 = model.score(((enc, dec), y))
+        model.fit(((enc, dec), y), epochs=15)
+        assert model.score(((enc, dec), y)) < s0
+        out = model.output(enc, dec)
+        assert out.shape == (6, 7, 4)
+
+
+class TestGradients:
+    def test_gradient_check_dag(self, rng):
+        """Numeric-vs-analytic gradients through merge + elementwise vertices
+        (GradientCheckTestsComputationGraph equivalent)."""
+        conf = (
+            ComputationGraphConfiguration.builder()
+            .add_inputs("a", "b")
+            .set_input_types(InputType.feed_forward(3), InputType.feed_forward(3))
+            .add_layer("da", Dense(n_out=4, activation="tanh"), "a")
+            .add_layer("db", Dense(n_out=4, activation="tanh"), "b")
+            .add_vertex("sum", ElementWiseVertex(op="add"), "da", "db")
+            .add_vertex("merge", MergeVertex(), "sum", "da")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax"), "merge")
+            .set_outputs("out")
+            .build()
+        )
+        model = ComputationGraph(conf).init()
+        xa = rng.rand(5, 3).astype(np.float64)
+        xb = rng.rand(5, 3).astype(np.float64)
+        y = np.eye(2)[rng.randint(0, 2, 5)]
+        assert check_gradients(
+            model, model._input_dict((xa, xb)), (y,), subset=30
+        )
+
+
+class TestSerde:
+    def test_json_round_trip(self, rng):
+        conf = _simple_graph(updater={"type": "adam", "lr": 0.01})
+        s = conf.to_json()
+        conf2 = ComputationGraphConfiguration.from_json(s)
+        assert conf2.to_json() == s
+        m = ComputationGraph(conf2).init()
+        x, y = _iris_like(rng, 8)
+        assert m.output(x).shape == (8, 3)
+
+    def test_vertex_serde_all(self):
+        conf = (
+            ComputationGraphConfiguration.builder()
+            .add_inputs("in", "in2")
+            .set_input_types(InputType.recurrent(6), InputType.feed_forward(6))
+            .add_vertex("rev", ReverseTimeSeriesVertex(), "in")
+            .add_vertex("last", LastTimeStepVertex(), "rev")
+            .add_vertex("sub", SubsetVertex(from_index=0, to_index=3), "last")
+            .add_vertex("sc", ScaleVertex(scale=0.5), "sub")
+            .add_vertex("sh", ShiftVertex(shift=1.0), "sc")
+            .add_vertex("n", L2NormalizeVertex(), "sh")
+            .add_vertex("sub2", SubsetVertex(from_index=0, to_index=3), "in2")
+            .add_vertex("l2", L2Vertex(), "n", "sub2")
+            .add_layer("out", OutputLayer(n_out=1, activation="identity", loss="mse"), "l2")
+            .set_outputs("out")
+            .build()
+        )
+        conf2 = ComputationGraphConfiguration.from_json(conf.to_json())
+        assert conf2.to_json() == conf.to_json()
+        m = ComputationGraph(conf2).init()
+        x = np.random.RandomState(0).rand(4, 5, 6).astype(np.float32)
+        x2 = np.random.RandomState(1).rand(4, 6).astype(np.float32)
+        out = m.output(x, x2)
+        assert out.shape == (4, 1)
+
+    def test_save_restore_zip(self, rng, tmp_path):
+        x, y = _iris_like(rng, 16)
+        model = ComputationGraph(_simple_graph(updater={"type": "adam", "lr": 0.05})).init()
+        model.fit((x, y), epochs=5)
+        out_before = np.asarray(model.output(x))
+        p = tmp_path / "cg.zip"
+        save_network(model, p)
+        m2 = restore_network(p)
+        assert isinstance(m2, ComputationGraph)
+        np.testing.assert_allclose(np.asarray(m2.output(x)), out_before, rtol=1e-5)
+        assert m2.iteration == model.iteration
+        m2.fit((x, y), epochs=1)  # updater state restored and usable
+
+
+class TestClone:
+    def test_clone_independent(self, rng):
+        x, y = _iris_like(rng, 16)
+        model = ComputationGraph(_simple_graph(updater={"type": "adam", "lr": 0.05})).init()
+        model.fit((x, y), epochs=2)
+        c = model.clone()
+        out0 = np.asarray(c.output(x))
+        model.fit((x, y), epochs=3)
+        np.testing.assert_allclose(np.asarray(c.output(x)), out0, rtol=1e-6)
