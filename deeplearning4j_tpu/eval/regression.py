@@ -45,10 +45,7 @@ class RegressionEvaluation:
             n = labels.shape[-1]
             labels = labels.reshape(-1, n)
             predictions = predictions.reshape(-1, n)
-            if mask is not None:
-                keep = np.asarray(mask).reshape(-1) > 0
-                labels, predictions = labels[keep], predictions[keep]
-        elif mask is not None:
+        if mask is not None:
             keep = np.asarray(mask).reshape(-1) > 0
             labels, predictions = labels[keep], predictions[keep]
         if labels.ndim == 1:

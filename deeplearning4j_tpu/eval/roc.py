@@ -37,7 +37,9 @@ class ROC:
         labels = np.asarray(labels)
         predictions = np.asarray(predictions)
         if labels.ndim == 2:
-            labels = labels.argmax(axis=-1)
+            # [n,1] is a single sigmoid column (values ARE the labels);
+            # argmax would map every row to class 0
+            labels = labels[:, 0] if labels.shape[1] == 1 else labels.argmax(axis=-1)
         if predictions.ndim == 2:
             if predictions.shape[1] > 2:
                 raise ValueError(

@@ -701,6 +701,11 @@ class ComputationGraph:
                                        train=train, rng=rng, mask=m)
                 mask_acts[name] = v.config.propagate_mask(m, it)
             else:
+                # mask_input: vertex reads the mask of a NAMED input instead
+                # of its propagated one (rnn/LastTimeStepVertex.java semantics)
+                ms = getattr(v.config, "mask_input", None)
+                if ms is not None:
+                    in_masks = [mask_acts.get(ms)] + in_masks[1:]
                 y, ns = v.config.apply(params[name], state[name], xs,
                                        train=train, rng=rng, masks=in_masks)
                 mask_acts[name] = v.config.propagate_mask(in_masks, v.input_types)
@@ -852,6 +857,9 @@ class ComputationGraph:
                 and all(_is_arr(e) for e in f)
             )
 
+        if isinstance(data, dict):
+            yield self._as_multi_batch(data)
+            return
         if isinstance(data, (tuple, list)) and 2 <= len(data) <= 4 and _features_like(data[0]):
             f, l, fm, lm = self._as_multi_batch(data)
             n = f[0].shape[0]

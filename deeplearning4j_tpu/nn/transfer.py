@@ -1,0 +1,326 @@
+"""Transfer learning: surgery on trained networks.
+
+Parity: nn/transferlearning/TransferLearning.java:32 (Builder:34,
+setFeatureExtractor:84, nOutReplace:98-143, GraphBuilder),
+FineTuneConfiguration.java, TransferLearningHelper.java.
+
+TPU-first mechanics: "freeze" is ``trainable=False`` on a layer config —
+the build assigns that layer a no-op updater, and because the whole step is
+one jitted function XLA dead-code-eliminates the frozen layers' gradient
+computation entirely (the reference instead wraps layers in FrozenLayer
+objects that skip applyUpdater at runtime). Param transfer is by
+shape-matched copy into a freshly-built model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.graph import (
+    ComputationGraph,
+    ComputationGraphConfiguration,
+    VertexSpec,
+)
+from deeplearning4j_tpu.nn.model import MultiLayerConfiguration, MultiLayerNetwork
+
+
+@dataclass
+class FineTuneConfiguration:
+    """Global overrides applied to every layer during surgery
+    (FineTuneConfiguration.java). Only non-None fields are applied."""
+
+    updater: Any = None
+    seed: Optional[int] = None
+    dropout: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+
+    def apply_to_layer(self, layer):
+        kw = {}
+        if self.dropout is not None and hasattr(layer, "dropout"):
+            kw["dropout"] = self.dropout
+        if self.l1 is not None and hasattr(layer, "l1"):
+            kw["l1"] = self.l1
+        if self.l2 is not None and hasattr(layer, "l2"):
+            kw["l2"] = self.l2
+        return dataclasses.replace(layer, **kw) if kw else layer
+
+
+def _tree_shapes_match(a, b) -> bool:
+    la, sa = jax.tree_util.tree_flatten(a)
+    lb, sb = jax.tree_util.tree_flatten(b)
+    if sa != sb or len(la) != len(lb):
+        return False
+    return all(x.shape == y.shape and x.dtype == y.dtype for x, y in zip(la, lb))
+
+
+class TransferLearning:
+    """Entry point: ``TransferLearning.builder(mln)`` or
+    ``TransferLearning.graph_builder(cg)``."""
+
+    @staticmethod
+    def builder(model: MultiLayerNetwork) -> "TransferLearningBuilder":
+        return TransferLearningBuilder(model)
+
+    @staticmethod
+    def graph_builder(model: ComputationGraph) -> "TransferLearningGraphBuilder":
+        return TransferLearningGraphBuilder(model)
+
+
+class TransferLearningBuilder:
+    """Sequential-model surgery (TransferLearning.Builder). Layer indices
+    refer to the USER config (conf.layers), not the resolved stack."""
+
+    def __init__(self, model: MultiLayerNetwork):
+        if model.params is None:
+            raise ValueError("Transfer learning needs an initialized model")
+        self._model = model
+        self._layers: List[Any] = list(model.conf.layers)
+        self._ftc: Optional[FineTuneConfiguration] = None
+        self._freeze_until: Optional[int] = None
+
+    def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+        self._ftc = ftc
+        return self
+
+    def set_feature_extractor(self, layer_idx: int):
+        """Freeze layers 0..layer_idx inclusive (setFeatureExtractor:84)."""
+        self._freeze_until = layer_idx
+        return self
+
+    def n_out_replace(self, layer_idx: int, n_out: int, weight_init: Any = None):
+        """Change a layer's n_out; its params and the NEXT layer's (n_in
+        changes) are re-initialized (nOutReplace:98-143)."""
+        layer = self._layers[layer_idx]
+        kw: Dict[str, Any] = {"n_out": n_out}
+        if weight_init is not None:
+            kw["weight_init"] = weight_init
+        self._layers[layer_idx] = dataclasses.replace(layer, **kw)
+        if layer_idx + 1 < len(self._layers) and hasattr(self._layers[layer_idx + 1], "n_in"):
+            # clear explicit n_in so it re-infers from the new n_out
+            self._layers[layer_idx + 1] = dataclasses.replace(
+                self._layers[layer_idx + 1], n_in=None
+            )
+        return self
+
+    def remove_output_layer(self):
+        self._layers.pop()
+        return self
+
+    def remove_layers_from_output(self, n: int):
+        del self._layers[len(self._layers) - n :]
+        return self
+
+    def add_layer(self, layer):
+        self._layers.append(layer)
+        return self
+
+    def build(self) -> MultiLayerNetwork:
+        layers = list(self._layers)
+        if self._freeze_until is not None:
+            for i in range(min(self._freeze_until + 1, len(layers))):
+                layers[i] = dataclasses.replace(layers[i], trainable=False)
+        if self._ftc is not None:
+            layers = [self._ftc.apply_to_layer(l) for l in layers]
+        conf_kw = dict(
+            layers=tuple(layers),
+            input_type=self._model.conf.input_type,
+            seed=self._model.conf.seed if not (self._ftc and self._ftc.seed is not None)
+            else self._ftc.seed,
+            updater=self._ftc.updater if (self._ftc and self._ftc.updater is not None)
+            else self._model.conf.updater,
+            dtype=self._model.conf.dtype,
+            backprop_type=self._model.conf.backprop_type,
+            tbptt_fwd_length=self._model.conf.tbptt_fwd_length,
+            tbptt_back_length=self._model.conf.tbptt_back_length,
+        )
+        new = MultiLayerNetwork(MultiLayerConfiguration(**conf_kw)).init()
+        # shape-matched positional param transfer over the resolved stacks
+        for i in range(min(len(new.params), len(self._model.params))):
+            if _tree_shapes_match(new.params[i], self._model.params[i]):
+                new.params = new.params[:i] + (
+                    jax.tree_util.tree_map(jnp.copy, self._model.params[i]),
+                ) + new.params[i + 1 :]
+                new.state = new.state[:i] + (
+                    jax.tree_util.tree_map(jnp.copy, self._model.state[i]),
+                ) + new.state[i + 1 :]
+        return new
+
+
+class TransferLearningGraphBuilder:
+    """DAG surgery (TransferLearning.GraphBuilder): vertices addressed by
+    name; params transfer by name + shape match."""
+
+    def __init__(self, model: ComputationGraph):
+        if model.params is None:
+            raise ValueError("Transfer learning needs an initialized model")
+        self._model = model
+        conf = model.conf
+        self._vertices: Dict[str, VertexSpec] = dict(conf.vertices)
+        self._outputs = list(conf.outputs)
+        self._ftc: Optional[FineTuneConfiguration] = None
+        self._frozen: set = set()
+
+    def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+        self._ftc = ftc
+        return self
+
+    def set_feature_extractor(self, *vertex_names: str):
+        """Freeze the named vertices and everything upstream of them."""
+        conf = self._model.conf
+        frontier = list(vertex_names)
+        while frontier:
+            v = frontier.pop()
+            if v in self._frozen or v not in self._vertices:
+                continue
+            self._frozen.add(v)
+            frontier.extend(self._vertices[v].inputs)
+        return self
+
+    def n_out_replace(self, name: str, n_out: int, weight_init: Any = None):
+        spec = self._vertices[name]
+        kw: Dict[str, Any] = {"n_out": n_out}
+        if weight_init is not None:
+            kw["weight_init"] = weight_init
+        self._vertices[name] = VertexSpec(
+            dataclasses.replace(spec.config, **kw), spec.inputs
+        )
+        # clear explicit n_in on direct consumers so they re-infer
+        for vname, vspec in list(self._vertices.items()):
+            if name in vspec.inputs and hasattr(vspec.config, "n_in") \
+                    and vspec.config.n_in is not None:
+                self._vertices[vname] = VertexSpec(
+                    dataclasses.replace(vspec.config, n_in=None), vspec.inputs
+                )
+        return self
+
+    def remove_vertex(self, name: str, and_outputs: bool = False):
+        self._vertices.pop(name)
+        if and_outputs and name in self._outputs:
+            self._outputs.remove(name)
+        return self
+
+    def add_layer(self, name: str, layer, *inputs: str):
+        self._vertices[name] = VertexSpec(layer, tuple(inputs))
+        return self
+
+    def add_vertex(self, name: str, vertex, *inputs: str):
+        self._vertices[name] = VertexSpec(vertex, tuple(inputs))
+        return self
+
+    def set_outputs(self, *names: str):
+        self._outputs = list(names)
+        return self
+
+    def build(self) -> ComputationGraph:
+        vertices: Dict[str, VertexSpec] = {}
+        for name, spec in self._vertices.items():
+            cfg = spec.config
+            if name in self._frozen:
+                cfg = dataclasses.replace(cfg, trainable=False)
+            if self._ftc is not None and hasattr(cfg, "dropout"):
+                cfg = self._ftc.apply_to_layer(cfg)
+            vertices[name] = VertexSpec(cfg, spec.inputs)
+        old = self._model.conf
+        conf = ComputationGraphConfiguration(
+            inputs=old.inputs,
+            input_types=old.input_types,
+            vertices=vertices,
+            outputs=tuple(self._outputs),
+            seed=old.seed,
+            updater=self._ftc.updater if (self._ftc and self._ftc.updater is not None)
+            else old.updater,
+            dtype=old.dtype,
+        )
+        new = ComputationGraph(conf).init()
+        for name in new.params:
+            if name in self._model.params and _tree_shapes_match(
+                new.params[name], self._model.params[name]
+            ):
+                new.params[name] = jax.tree_util.tree_map(
+                    jnp.copy, self._model.params[name]
+                )
+                new.state[name] = jax.tree_util.tree_map(
+                    jnp.copy, self._model.state[name]
+                )
+        return new
+
+
+class TransferLearningHelper:
+    """Featurize-once training of the unfrozen tail
+    (TransferLearningHelper.java): run the frozen front once per dataset,
+    then iterate only the small unfrozen sub-network."""
+
+    def __init__(self, model: MultiLayerNetwork, frozen_till: int):
+        """``frozen_till``: last frozen USER layer index (inclusive)."""
+        if model.params is None:
+            raise ValueError("needs an initialized model")
+        self._model = model
+        # map user layer index -> resolved index (auto-inserted preprocessors
+        # shift it; they are registered under "pp_*" type names)
+        resolved_idx = -1
+        user_idx = -1
+        for i, l in enumerate(model.layers):
+            if not l._type_name.startswith("pp_"):
+                user_idx += 1
+            if user_idx == frozen_till:
+                resolved_idx = i
+                break
+        if resolved_idx < 0:
+            raise ValueError(f"frozen_till={frozen_till} out of range")
+        self._boundary = resolved_idx + 1
+        sub_layers = tuple(model.layers[self._boundary :])
+        sub_conf = MultiLayerConfiguration(
+            layers=sub_layers,
+            input_type=model.layer_input_types[self._boundary]
+            if self._boundary < len(model.layers) else model.output_type,
+            seed=model.conf.seed,
+            updater=model.conf.updater,
+            dtype=model.conf.dtype,
+        )
+        self._sub = MultiLayerNetwork(sub_conf).init()
+        self._sub.params = tuple(
+            jax.tree_util.tree_map(jnp.copy, p) for p in model.params[self._boundary :]
+        )
+        self._sub.state = tuple(
+            jax.tree_util.tree_map(jnp.copy, s) for s in model.state[self._boundary :]
+        )
+
+    @property
+    def unfrozen_network(self) -> MultiLayerNetwork:
+        return self._sub
+
+    def featurize(self, batch):
+        """(x, y, ...) -> (features_at_boundary, y, ...)."""
+        from deeplearning4j_tpu.nn.model import _as_batch
+
+        x, y, fm, lm = _as_batch(batch)
+        a, _, _, mask, _ = self._model._forward(
+            self._model.params, self._model.state, jnp.asarray(x, self._model.dtype),
+            train=False, rngs=None,
+            fmask=jnp.asarray(fm, self._model.dtype) if fm is not None else None,
+            upto=self._boundary,
+        )
+        return (np.asarray(a), y, np.asarray(mask) if mask is not None else None, lm)
+
+    def fit_featurized(self, featurized, epochs: int = 1, batch_size=None):
+        self._sub.fit(featurized, epochs=epochs, batch_size=batch_size)
+        # write trained tail params back into the full model
+        n = len(self._model.params)
+        self._model.params = self._model.params[: self._boundary] + tuple(
+            jax.tree_util.tree_map(jnp.copy, p) for p in self._sub.params
+        )
+        self._model.state = self._model.state[: self._boundary] + tuple(
+            jax.tree_util.tree_map(jnp.copy, s) for s in self._sub.state
+        )
+        assert len(self._model.params) == n
+        return self._sub
+
+    def output_from_featurized(self, features):
+        return self._sub.output(features)

@@ -40,6 +40,7 @@ class ParallelInference:
         self.max_batch_size = max_batch_size
         self._queue: "queue.Queue[_Pending]" = queue.Queue(maxsize=queue_limit)
         self._stop = threading.Event()
+        self._lifecycle_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         if mode == "batched" and worker:
             self._thread = threading.Thread(target=self._worker_loop, daemon=True)
@@ -47,32 +48,39 @@ class ParallelInference:
 
     # -- public ------------------------------------------------------------
     def output(self, x) -> np.ndarray:
-        if self._stop.is_set():
-            raise RuntimeError("ParallelInference is shut down")
         x = np.asarray(x)
         if self.mode != "batched" or self._thread is None:
+            if self._stop.is_set():
+                raise RuntimeError("ParallelInference is shut down")
             return np.asarray(self.model.output(x))
         p = _Pending(x)
-        self._queue.put(p)
+        # enqueue under the shutdown lock so a request can't slip into the
+        # queue after shutdown() drained it (check-then-put race)
+        with self._lifecycle_lock:
+            if self._stop.is_set():
+                raise RuntimeError("ParallelInference is shut down")
+            self._queue.put(p)
         p.event.wait()
         if isinstance(p.result, Exception):
             raise p.result
         return p.result
 
     def shutdown(self):
-        self._stop.set()
+        with self._lifecycle_lock:
+            self._stop.set()
         if self._thread is not None:
             self._queue.put(_Pending(None))  # wake the worker
             self._thread.join(timeout=5)
-            # fail any requests stranded in the queue so waiters don't hang
-            while True:
-                try:
-                    p = self._queue.get_nowait()
-                except queue.Empty:
-                    break
-                if p.x is not None:
-                    p.result = RuntimeError("ParallelInference shut down")
-                    p.event.set()
+            with self._lifecycle_lock:
+                # fail requests stranded in the queue so waiters don't hang
+                while True:
+                    try:
+                        p = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    if p.x is not None:
+                        p.result = RuntimeError("ParallelInference shut down")
+                        p.event.set()
 
     # -- worker ------------------------------------------------------------
     def _drain(self) -> List[_Pending]:

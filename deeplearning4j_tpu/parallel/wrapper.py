@@ -60,37 +60,41 @@ class ParallelWrapper:
         if self.model.opt_state is not None:
             self.model.opt_state = jax.tree_util.tree_map(put, self.model.opt_state)
 
+    def _pad_to_shardable(self, arrs):
+        """Tile members of a batch so the leading axis divides n_data."""
+        n = next(len(a) for a in arrs if a is not None)
+        if n % self.n_data == 0:
+            return arrs, n
+        pad = self.n_data - n % self.n_data
+
+        def _pad(a):
+            if a is None:
+                return None
+            a = np.asarray(a)
+            reps = np.concatenate([a] * (pad // n + 1))[:pad]
+            return np.concatenate([a, reps])
+
+        return tuple(_pad(a) for a in arrs), n
+
     def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None):
         """Data-parallel fit: identical semantics to ``model.fit`` on a batch
         ``batch_size`` large, executed across all chips."""
         if self.model.params is None:
             self.model.init()
         self._replicate_model()
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        if isinstance(self.model, ComputationGraph):
+            return self._fit_graph(data, epochs, batch_size)
         model = self.model
         for _ in range(epochs):
             for l in model.listeners:
                 l.on_epoch_start(model, model.epoch)
             source = data() if callable(data) else data
-            for x, y, fm, lm in _iter_batches(source, batch_size):
-                n = len(x)
-                if n % self.n_data != 0:
-                    # pad to a shardable batch (masked examples would be
-                    # better; DL4J just sends uneven batches to workers)
-                    pad = self.n_data - n % self.n_data
-                    # tile so any n reaches the next multiple of n_data (a
-                    # slice x[:pad] is short when pad > n)
-                    def _pad(a):
-                        a = np.asarray(a)
-                        reps = np.concatenate([a] * (pad // n + 1))[:pad]
-                        return np.concatenate([a, reps])
-
-                    x = _pad(x)
-                    if y is not None:
-                        y = _pad(y)
-                    if fm is not None:
-                        fm = _pad(fm)
-                    if lm is not None:
-                        lm = _pad(lm)
+            for batch in _iter_batches(source, batch_size):
+                # pad so the batch shards exactly (the reference round-robins
+                # whole DataSets to workers; here the split must be even)
+                (x, y, fm, lm), n = self._pad_to_shardable(batch)
                 score = model._fit_batch(
                     self._shard(x), self._shard(y), self._shard(fm), self._shard(lm)
                 )
@@ -103,6 +107,48 @@ class ParallelWrapper:
             model.epoch += 1
         return model
 
+    def _fit_graph(self, data, epochs: int, batch_size: Optional[int]):
+        """ComputationGraph variant: shard every member of the MultiDataSet
+        (features/labels/masks tuples) along the data axis."""
+        model = self.model
+        shard_t = lambda t: tuple(self._shard(a) for a in t) if t is not None else None
+        for _ in range(epochs):
+            for l in model.listeners:
+                l.on_epoch_start(model, model.epoch)
+            source = data() if callable(data) else data
+            for f, lbl, fm, lm in model._iter_multi(source, batch_size):
+                f, n = self._pad_to_shardable(f)
+                if lbl is not None:
+                    lbl, _ = self._pad_to_shardable(lbl)
+                if fm is not None:
+                    fm, _ = self._pad_to_shardable(fm)
+                if lm is not None:
+                    lm, _ = self._pad_to_shardable(lm)
+                score = model.fit_batch(
+                    (shard_t(f), shard_t(lbl), shard_t(fm), shard_t(lm))
+                )
+                if model.listeners:
+                    score = float(score)
+                    for l in model.listeners:
+                        l.iteration_done(model, model.iteration, score, n)
+            for l in model.listeners:
+                l.on_epoch_end(model, model.epoch)
+            model.epoch += 1
+        return model
+
     def output(self, x):
-        """Sharded batched inference across the mesh."""
-        return self.model.output(self._shard(np.asarray(x)))
+        """Sharded batched inference across the mesh (uneven batches are
+        padded for the sharded call and trimmed from the result)."""
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        if isinstance(x, (tuple, list)):
+            xs, n = self._pad_to_shardable(tuple(np.asarray(a) for a in x))
+            if isinstance(self.model, ComputationGraph):
+                out = self.model.output(*[self._shard(a) for a in xs])
+            else:
+                out = self.model.output(self._shard(xs[0]))
+            trim = lambda o: o[:n]
+            return jax.tree_util.tree_map(trim, out)
+        (xp,), n = self._pad_to_shardable((np.asarray(x),))
+        out = self.model.output(self._shard(xp))
+        return jax.tree_util.tree_map(lambda o: o[:n], out)

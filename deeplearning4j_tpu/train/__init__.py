@@ -24,6 +24,23 @@ from deeplearning4j_tpu.train.listeners import (
     TimeIterationListener,
     TrainingListener,
 )
+from deeplearning4j_tpu.train.checkpoint import Checkpoint, CheckpointListener
+from deeplearning4j_tpu.train.earlystopping import (
+    BestScoreEpochTerminationCondition,
+    ClassificationScoreCalculator,
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingGraphTrainer,
+    EarlyStoppingResult,
+    EarlyStoppingTrainer,
+    InMemoryModelSaver,
+    InvalidScoreIterationTerminationCondition,
+    LocalFileModelSaver,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
 
 __all__ = [
     "Updater",
@@ -37,4 +54,20 @@ __all__ = [
     "CollectScoresListener",
     "TimeIterationListener",
     "ComposedListener",
+    "Checkpoint",
+    "CheckpointListener",
+    "EarlyStoppingConfiguration",
+    "EarlyStoppingResult",
+    "EarlyStoppingTrainer",
+    "EarlyStoppingGraphTrainer",
+    "MaxEpochsTerminationCondition",
+    "ScoreImprovementEpochTerminationCondition",
+    "BestScoreEpochTerminationCondition",
+    "MaxTimeIterationTerminationCondition",
+    "MaxScoreIterationTerminationCondition",
+    "InvalidScoreIterationTerminationCondition",
+    "DataSetLossCalculator",
+    "ClassificationScoreCalculator",
+    "InMemoryModelSaver",
+    "LocalFileModelSaver",
 ]

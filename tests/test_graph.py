@@ -336,6 +336,48 @@ class TestSerde:
         m2.fit((x, y), epochs=1)  # updater state restored and usable
 
 
+class TestReviewRegressions:
+    def test_fit_with_dict_batch(self, rng):
+        x, y = _iris_like(rng, 16)
+        model = ComputationGraph(_simple_graph(updater={"type": "adam", "lr": 0.05})).init()
+        s0 = model.score({"features": x, "labels": y})
+        for _ in range(20):
+            model.fit({"features": x, "labels": y})
+        assert model.score({"features": x, "labels": y}) < s0
+
+    def test_roc_single_column_labels(self):
+        from deeplearning4j_tpu.eval import ROC
+
+        roc = ROC(num_bins=0)
+        roc.eval(np.array([[1.0], [0.0], [1.0], [0.0]]),
+                 np.array([[0.9], [0.1], [0.8], [0.2]]))
+        assert roc.calculate_auc() == 1.0
+
+    def test_last_time_step_mask_input_named(self, rng):
+        """mask_input='in' selects the NETWORK INPUT's mask even when the
+        vertex's direct input propagates none."""
+        conf = (
+            ComputationGraphConfiguration.builder()
+            .add_inputs("in")
+            .set_input_types(InputType.recurrent(4))
+            .add_vertex("last", LastTimeStepVertex(mask_input="in"), "in")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax"), "last")
+            .set_outputs("out")
+            .build()
+        )
+        model = ComputationGraph(conf).init()
+        x = rng.randn(2, 5, 4).astype(np.float32)
+        mask = np.array([[1, 1, 0, 0, 0], [1, 1, 1, 1, 1]], np.float32)
+        acts, _, _ = model._forward(
+            model.params, model.state, {"in": jnp.asarray(x)},
+            train=False, rngs=None, masks={"in": jnp.asarray(mask)},
+        )
+        expect0 = x[0, 1]  # last unmasked step of example 0
+        got = np.asarray(acts["last"])
+        np.testing.assert_allclose(got[0], expect0, rtol=1e-6)
+        np.testing.assert_allclose(got[1], x[1, 4], rtol=1e-6)
+
+
 class TestClone:
     def test_clone_independent(self, rng):
         x, y = _iris_like(rng, 16)

@@ -86,6 +86,37 @@ class TestParallelWrapper:
         assert out.shape == (32, 2)
 
 
+class TestParallelWrapperGraph:
+    def test_dp_fit_computation_graph(self):
+        """ParallelWrapper drives a ComputationGraph: sharded MultiDataSet
+        batches, score decreases, padded uneven batch works."""
+        from deeplearning4j_tpu.nn.graph import ComputationGraph, ComputationGraphConfiguration, MergeVertex
+
+        conf = (
+            ComputationGraphConfiguration.builder()
+            .add_inputs("a", "b")
+            .set_input_types(InputType.feed_forward(4), InputType.feed_forward(4))
+            .add_layer("da", Dense(n_out=8, activation="tanh"), "a")
+            .add_layer("db", Dense(n_out=8, activation="tanh"), "b")
+            .add_vertex("m", MergeVertex(), "da", "db")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax"), "m")
+            .set_outputs("out")
+            .updater({"type": "adam", "lr": 0.05})
+            .build()
+        )
+        model = ComputationGraph(conf).init()
+        rs = np.random.RandomState(0)
+        xa = rs.randn(60, 4).astype(np.float32)  # 60 % 8 != 0 -> padding path
+        xb = rs.randn(60, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[((xa + xb).sum(1) > 0).astype(int)]
+        pw = ParallelWrapper(model, mesh=make_mesh(MeshSpec(data=8)))
+        s0 = model.score(((xa, xb), y))
+        pw.fit(((xa, xb), y), epochs=25)
+        assert model.score(((xa, xb), y)) < s0 * 0.8
+        out = pw.output((xa, xb))
+        assert out.shape == (60, 2)  # padded for sharding, trimmed back
+
+
 class TestParallelInference:
     def test_inplace_mode(self):
         model = _model()

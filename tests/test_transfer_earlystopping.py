@@ -1,0 +1,281 @@
+"""Transfer learning, early stopping, and checkpoint listener tests.
+
+Mirrors the reference's transferlearning/, earlystopping/, and
+CheckpointListener test coverage (SURVEY.md §4).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.graph import ComputationGraph, ComputationGraphConfiguration, MergeVertex
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers.core import Dense, OutputLayer
+from deeplearning4j_tpu.nn.model import MultiLayerConfiguration, MultiLayerNetwork
+from deeplearning4j_tpu.nn.transfer import (
+    FineTuneConfiguration,
+    TransferLearning,
+    TransferLearningHelper,
+)
+from deeplearning4j_tpu.train import (
+    CheckpointListener,
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    InvalidScoreIterationTerminationCondition,
+    LocalFileModelSaver,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+
+
+def _data(rng, n=64, nf=4, nc=3):
+    x = rng.rand(n, nf).astype(np.float32)
+    w = np.linspace(-1, 1, nf * nc).reshape(nf, nc)
+    y = np.eye(nc, dtype=np.float32)[(x @ w).argmax(-1)]
+    return x, y
+
+
+def _mln(updater={"type": "adam", "lr": 0.05}):
+    conf = MultiLayerConfiguration(
+        layers=(
+            Dense(n_out=8, activation="tanh"),
+            Dense(n_out=8, activation="tanh"),
+            OutputLayer(n_out=3, activation="softmax"),
+        ),
+        input_type=InputType.feed_forward(4),
+        updater=updater,
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+class TestTransferLearningMLN:
+    def test_frozen_layers_do_not_change(self, rng):
+        x, y = _data(rng)
+        model = _mln()
+        model.fit((x, y), epochs=3)
+        new = (
+            TransferLearning.builder(model)
+            .set_feature_extractor(0)
+            .build()
+        )
+        w0_before = np.asarray(new.params[0]["W"])
+        new.fit((x, y), epochs=5)
+        np.testing.assert_array_equal(np.asarray(new.params[0]["W"]), w0_before)
+        # unfrozen layers DID change
+        assert not np.allclose(
+            np.asarray(new.params[1]["W"]), np.asarray(model.params[1]["W"])
+        )
+
+    def test_params_transferred(self, rng):
+        x, y = _data(rng)
+        model = _mln()
+        model.fit((x, y), epochs=3)
+        new = TransferLearning.builder(model).set_feature_extractor(0).build()
+        for i in range(3):
+            np.testing.assert_allclose(
+                np.asarray(new.params[i]["W"]), np.asarray(model.params[i]["W"])
+            )
+
+    def test_n_out_replace(self, rng):
+        x, y = _data(rng)
+        model = _mln()
+        model.fit((x, y), epochs=2)
+        new = (
+            TransferLearning.builder(model)
+            .n_out_replace(2, 5)  # new head: 5 classes
+            .build()
+        )
+        assert new.output(x).shape == (64, 5)
+        # untouched layers transferred
+        np.testing.assert_allclose(
+            np.asarray(new.params[0]["W"]), np.asarray(model.params[0]["W"])
+        )
+
+    def test_remove_and_add_layers(self, rng):
+        x, y = _data(rng)
+        model = _mln()
+        new = (
+            TransferLearning.builder(model)
+            .remove_output_layer()
+            .add_layer(Dense(n_out=6, activation="relu"))
+            .add_layer(OutputLayer(n_out=2, activation="softmax"))
+            .build()
+        )
+        assert new.output(x).shape == (64, 2)
+
+    def test_fine_tune_updater_override(self, rng):
+        model = _mln(updater="sgd")
+        new = (
+            TransferLearning.builder(model)
+            .fine_tune_configuration(FineTuneConfiguration(updater={"type": "adam", "lr": 0.01}))
+            .build()
+        )
+        assert new.conf.updater == {"type": "adam", "lr": 0.01}
+
+
+class TestTransferLearningGraph:
+    def _graph(self):
+        conf = (
+            ComputationGraphConfiguration.builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(4))
+            .add_layer("f1", Dense(n_out=8, activation="tanh"), "in")
+            .add_layer("f2", Dense(n_out=8, activation="tanh"), "f1")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax"), "f2")
+            .set_outputs("out")
+            .updater({"type": "adam", "lr": 0.05})
+            .build()
+        )
+        return ComputationGraph(conf).init()
+
+    def test_freeze_upstream(self, rng):
+        x, y = _data(rng)
+        model = self._graph()
+        model.fit((x, y), epochs=3)
+        new = TransferLearning.graph_builder(model).set_feature_extractor("f1").build()
+        w_before = np.asarray(new.params["f1"]["W"])
+        new.fit((x, y), epochs=5)
+        np.testing.assert_array_equal(np.asarray(new.params["f1"]["W"]), w_before)
+
+    def test_replace_head(self, rng):
+        x, y = _data(rng)
+        model = self._graph()
+        model.fit((x, y), epochs=2)
+        new = (
+            TransferLearning.graph_builder(model)
+            .remove_vertex("out", and_outputs=True)
+            .add_layer("new_out", OutputLayer(n_out=7, activation="softmax"), "f2")
+            .set_outputs("new_out")
+            .build()
+        )
+        assert new.output(x).shape == (64, 7)
+        np.testing.assert_allclose(
+            np.asarray(new.params["f1"]["W"]), np.asarray(model.params["f1"]["W"])
+        )
+
+
+class TestTransferLearningHelper:
+    def test_featurize_and_fit(self, rng):
+        x, y = _data(rng)
+        model = _mln()
+        model.fit((x, y), epochs=2)
+        helper = TransferLearningHelper(model, frozen_till=1)
+        feats = helper.featurize((x, y))
+        assert feats[0].shape == (64, 8)
+        out_before_full = np.asarray(model.output(x))
+        helper.fit_featurized(feats, epochs=10)
+        # tail was trained and written back; frozen front unchanged -> the
+        # featurized output path equals the full model path
+        full = np.asarray(model.output(x))
+        via_helper = np.asarray(helper.output_from_featurized(feats[0]))
+        np.testing.assert_allclose(full, via_helper, rtol=1e-4, atol=1e-5)
+        assert not np.allclose(full, out_before_full)
+
+
+class TestEarlyStopping:
+    def test_max_epochs(self, rng):
+        x, y = _data(rng)
+        model = _mln()
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(5)],
+            score_calculator=DataSetLossCalculator((x, y)),
+        )
+        result = EarlyStoppingTrainer(cfg, model, (x, y)).fit()
+        assert result.total_epochs == 5
+        assert result.termination_reason == "EpochTerminationCondition"
+        assert "MaxEpochs" in result.termination_details
+        assert result.best_model is not None
+        assert len(result.score_vs_epoch) == 5
+
+    def test_score_improvement_patience(self, rng):
+        x, y = _data(rng)
+        model = _mln(updater={"type": "sgd", "lr": 1e-9})  # no progress
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[
+                MaxEpochsTerminationCondition(50),
+                ScoreImprovementEpochTerminationCondition(patience=3, min_improvement=1e-3),
+            ],
+            score_calculator=DataSetLossCalculator((x, y)),
+        )
+        result = EarlyStoppingTrainer(cfg, model, (x, y)).fit()
+        assert result.total_epochs <= 6
+        assert "ScoreImprovement" in result.termination_details
+
+    def test_divergence_stops_iteration(self, rng):
+        x, y = _data(rng)
+        model = _mln(updater={"type": "sgd", "lr": 1e6})  # diverges
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(50)],
+            iteration_termination_conditions=[
+                MaxScoreIterationTerminationCondition(1e4),
+                InvalidScoreIterationTerminationCondition(),
+            ],
+            score_calculator=DataSetLossCalculator((x, y)),
+        )
+        result = EarlyStoppingTrainer(cfg, model, (x, y), batch_size=16).fit()
+        assert result.termination_reason == "IterationTerminationCondition"
+        assert result.total_epochs < 50
+
+    def test_best_model_saved_to_disk(self, rng, tmp_path):
+        x, y = _data(rng)
+        model = _mln()
+        saver = LocalFileModelSaver(tmp_path)
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(4)],
+            score_calculator=DataSetLossCalculator((x, y)),
+            model_saver=saver,
+        )
+        result = EarlyStoppingTrainer(cfg, model, (x, y)).fit()
+        assert os.path.exists(tmp_path / "bestModel.zip")
+        best = saver.get_best_model()
+        assert best is not None
+        assert best.output(x).shape == (64, 3)
+        assert result.best_model_score <= min(result.score_vs_epoch.values()) + 1e-9
+
+
+class TestCheckpointListener:
+    def test_save_every_epoch_keep_last(self, rng, tmp_path):
+        x, y = _data(rng, n=32)
+        model = _mln()
+        cl = CheckpointListener(tmp_path, save_every_n_epochs=1, keep_last=2)
+        model.set_listeners(cl)
+        model.fit((x, y), epochs=5)
+        cps = CheckpointListener.checkpoints(tmp_path)
+        assert len(cps) == 2
+        assert cps[-1].number == 4
+        files = [f for f in os.listdir(tmp_path) if f.endswith(".zip")]
+        assert len(files) == 2
+
+    def test_keep_last_and_every(self, rng, tmp_path):
+        x, y = _data(rng, n=32)
+        model = _mln()
+        cl = CheckpointListener(
+            tmp_path, save_every_n_epochs=1, keep_last_and_every=(2, 3)
+        )
+        model.set_listeners(cl)
+        model.fit((x, y), epochs=7)
+        nums = {c.number for c in CheckpointListener.checkpoints(tmp_path)}
+        assert nums == {0, 3, 5, 6}  # every-3rd (0,3,6) + last-2 (5,6)
+
+    def test_load_checkpoint(self, rng, tmp_path):
+        x, y = _data(rng, n=32)
+        model = _mln()
+        model.set_listeners(CheckpointListener(tmp_path, save_every_n_epochs=2, keep_all=True))
+        model.fit((x, y), epochs=4)
+        m2 = CheckpointListener.load_last_checkpoint(tmp_path)
+        np.testing.assert_allclose(
+            np.asarray(m2.output(x)), np.asarray(model.output(x)), rtol=1e-5
+        )
+
+    def test_save_every_n_iterations(self, rng, tmp_path):
+        x, y = _data(rng, n=64)
+        model = _mln()
+        model.set_listeners(
+            CheckpointListener(tmp_path, save_every_n_iterations=4, keep_all=True)
+        )
+        model.fit((x, y), epochs=3, batch_size=16)  # 4 iters/epoch = 12 iters
+        cps = CheckpointListener.checkpoints(tmp_path)
+        assert [c.iteration for c in cps] == [4, 8, 12]
