@@ -5,6 +5,6 @@ config builders. Each returns a configuration whose JSON round-trips, so zoo
 models are data, not code.
 """
 
-from deeplearning4j_tpu.models.zoo import LeNet5, SimpleCNN, TextGenerationLSTM
+from deeplearning4j_tpu.models.zoo import LeNet5, SimpleCNN, TextGenerationLSTM, TransformerLM
 
-__all__ = ["LeNet5", "SimpleCNN", "TextGenerationLSTM"]
+__all__ = ["LeNet5", "SimpleCNN", "TextGenerationLSTM", "TransformerLM"]

@@ -91,3 +91,50 @@ def TextGenerationLSTM(vocab_size: int = 77, timesteps: int = 50,
         tbptt_back_length=50,
         dtype=dtype,
     )
+
+
+def TransformerLM(vocab_size: int = 256, max_len: int = 512, d_model: int = 256,
+                  n_heads: int = 8, n_blocks: int = 4, ffn_mult: int = 4,
+                  sequence_parallel: bool = False, moe_experts: int = 0,
+                  updater=None, seed: int = 12345,
+                  dtype: str = "bfloat16") -> MultiLayerConfiguration:
+    """Decoder-only transformer language model — the framework's flagship.
+
+    Beyond-reference capability (the reference has no attention; its text
+    model is the GravesLSTM char-RNN). Designed TPU-first: bf16 by default,
+    fused qkv/MLP matmuls on the MXU, optional ring-attention sequence
+    parallelism (``sequence_parallel=True`` + a mesh with a ``seq`` axis),
+    optional MoE blocks (``moe_experts>0``) whose expert axis shards over the
+    mesh's ``model`` axis (expert parallelism).
+    """
+    from deeplearning4j_tpu.nn.layers import (
+        EmbeddingSequence,
+        LayerNorm,
+        MixtureOfExperts,
+        PositionalEmbedding,
+        RnnOutputLayer,
+        TransformerBlock,
+    )
+
+    layers = [
+        EmbeddingSequence(n_in=vocab_size, n_out=d_model),
+        PositionalEmbedding(max_len=max_len),
+    ]
+    for i in range(n_blocks):
+        layers.append(TransformerBlock(
+            n_heads=n_heads, ffn_mult=ffn_mult, causal=True,
+            sequence_parallel=sequence_parallel,
+        ))
+        if moe_experts and i % 2 == 1:  # MoE every second block, switch-style
+            layers.append(MixtureOfExperts(n_experts=moe_experts, ffn_mult=ffn_mult))
+    layers += [
+        LayerNorm(),
+        RnnOutputLayer(n_out=vocab_size, activation="softmax", loss="mcxent"),
+    ]
+    return MultiLayerConfiguration(
+        layers=tuple(layers),
+        input_type=InputType.recurrent(vocab_size, max_len),
+        updater=updater or {"type": "adam", "lr": 3e-4},
+        seed=seed,
+        dtype=dtype,
+    )

@@ -34,6 +34,7 @@ import numpy as np
 
 from deeplearning4j_tpu.nn.config import LayerConfig, layer_from_dict, _encode_value
 from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.model import _cast_input
 from deeplearning4j_tpu.nn.preprocessors import infer_preprocessor
 from deeplearning4j_tpu.train.updaters import (
     apply_gradient_normalization,
@@ -777,10 +778,10 @@ class ComputationGraph:
             return None
         if isinstance(v, (tuple, list)):
             return tuple(
-                jnp.asarray(x, self.dtype) if x is not None else None for x in v
+                _cast_input(x, self.dtype) for x in v
             )
-        return (jnp.asarray(v, self.dtype),) + (None,) * (n - 1) if n > 1 else (
-            jnp.asarray(v, self.dtype),
+        return (_cast_input(v, self.dtype),) + (None,) * (n - 1) if n > 1 else (
+            _cast_input(v, self.dtype),
         )
 
     def _as_multi_batch(self, batch):
@@ -857,10 +858,9 @@ class ComputationGraph:
                 and all(_is_arr(e) for e in f)
             )
 
-        if isinstance(data, dict):
-            yield self._as_multi_batch(data)
-            return
-        if isinstance(data, (tuple, list)) and 2 <= len(data) <= 4 and _features_like(data[0]):
+        if (isinstance(data, dict)
+                or (isinstance(data, (tuple, list)) and 2 <= len(data) <= 4
+                    and _features_like(data[0]))):
             f, l, fm, lm = self._as_multi_batch(data)
             n = f[0].shape[0]
             if batch_size is None or batch_size >= n:
@@ -902,7 +902,7 @@ class ComputationGraph:
         Returns a single array when the graph has one output."""
         if len(xs) == 1 and isinstance(xs[0], (tuple, list)):
             xs = tuple(xs[0])
-        feats = tuple(jnp.asarray(x, self.dtype) for x in xs)
+        feats = tuple(_cast_input(x, self.dtype) for x in xs)
         fm = self._norm_multi(fmasks, len(self.conf.inputs)) if fmasks is not None else None
         if self._output_fn is None:
             def fwd(params, state, inputs, masks):

@@ -6,10 +6,14 @@ targets the reference's nn/conf/layers/ set (~45 classes, SURVEY.md §2.1).
 
 from deeplearning4j_tpu.nn.layers.core import (
     ActivationLayer,
+    AlphaDropout,
     AutoEncoder,
     Dense,
     DropoutLayer,
     Embedding,
+    EmbeddingSequence,
+    GaussianDropout,
+    GaussianNoise,
     LossLayer,
     OutputLayer,
 )
@@ -24,7 +28,13 @@ from deeplearning4j_tpu.nn.layers.convolution import (
     Upsampling2D,
     ZeroPadding2D,
 )
-from deeplearning4j_tpu.nn.layers.normalization import BatchNorm, LocalResponseNormalization
+from deeplearning4j_tpu.nn.layers.normalization import BatchNorm, LayerNorm, LocalResponseNormalization
+from deeplearning4j_tpu.nn.layers.attention import (
+    MultiHeadAttention,
+    PositionalEmbedding,
+    TransformerBlock,
+)
+from deeplearning4j_tpu.nn.layers.moe import MixtureOfExperts
 from deeplearning4j_tpu.nn.layers.pooling import GlobalPooling
 from deeplearning4j_tpu.nn.layers.recurrent import (
     Bidirectional,
@@ -38,6 +48,10 @@ from deeplearning4j_tpu.nn.layers.recurrent import (
 
 __all__ = [
     "ActivationLayer",
+    "AlphaDropout",
+    "EmbeddingSequence",
+    "GaussianDropout",
+    "GaussianNoise",
     "AutoEncoder",
     "Dense",
     "DropoutLayer",
@@ -54,6 +68,11 @@ __all__ = [
     "Upsampling2D",
     "ZeroPadding2D",
     "BatchNorm",
+    "LayerNorm",
+    "MultiHeadAttention",
+    "PositionalEmbedding",
+    "TransformerBlock",
+    "MixtureOfExperts",
     "LocalResponseNormalization",
     "GlobalPooling",
     "Bidirectional",

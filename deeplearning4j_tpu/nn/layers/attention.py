@@ -1,0 +1,180 @@
+"""Attention / transformer layers — the long-context stack.
+
+Beyond-reference capability (the reference has NO attention layer anywhere —
+SURVEY.md §2.5/§5.7; its only long-sequence device is truncated BPTT). Here
+transformers are first-class and designed for the TPU:
+
+- ``MultiHeadAttention``: fused qkv projection (one MXU matmul), optional
+  causal masking, and optional **sequence parallelism**: when
+  ``sequence_parallel=True`` and a mesh with a ``seq`` axis is active (see
+  parallel/context.py), attention runs as ring attention over the mesh's
+  ``seq`` axis (parallel/ring.py) — K/V blocks rotate over ICI, O(T²) memory
+  never materializes on one chip.
+- ``TransformerBlock``: pre-LN block (LN→MHA→residual, LN→MLP→residual),
+  the standard compilation-friendly composition XLA fuses well.
+- ``PositionalEmbedding``: learned positions added to token embeddings.
+
+Tensor parallelism for these layers is sharding metadata, not code: see
+parallel/tp.py for the PartitionSpec rules (qkv/mlp-in column-parallel,
+out/mlp-out row-parallel).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import initializers
+from deeplearning4j_tpu.nn.config import LayerConfig, register_layer
+from deeplearning4j_tpu.nn.input_type import InputType
+
+
+def _mesh_has_axis(axis: str) -> bool:
+    from deeplearning4j_tpu.parallel.context import current_mesh
+
+    mesh = current_mesh()
+    return mesh is not None and axis in mesh.shape and mesh.shape[axis] > 1
+
+
+@register_layer("positional_embedding")
+@dataclass
+class PositionalEmbedding(LayerConfig):
+    """Learned positional embedding added to the input sequence [B,T,C]."""
+
+    max_len: int = 512
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        return {"pos": jax.random.normal(key, (self.max_len, input_type.size), dtype) * 0.02}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        T = x.shape[1]
+        return x + params["pos"][:T][None, :, :], state
+
+
+@register_layer("multi_head_attention")
+@dataclass
+class MultiHeadAttention(LayerConfig):
+    """Multi-head self-attention over [B, T, C].
+
+    ``sequence_parallel``: run the attention core as ring attention over the
+    active mesh's ``seq`` axis (requires T divisible by the axis size and the
+    time axis sharded over it).
+    """
+
+    n_heads: int = 8
+    causal: bool = False
+    sequence_parallel: bool = False
+    attn_dropout: float = 0.0
+    weight_init: Any = "xavier"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        C = input_type.size
+        if C % self.n_heads:
+            raise ValueError(f"n_heads={self.n_heads} must divide model dim {C}")
+        k1, k2 = jax.random.split(key)
+        return {
+            # fused qkv: one [C, 3C] matmul onto the MXU
+            "Wqkv": initializers.initialize(self.weight_init, k1, (C, 3 * C), C, 3 * C, dtype),
+            "bqkv": jnp.zeros((3 * C,), dtype),
+            "Wo": initializers.initialize(self.weight_init, k2, (C, C), C, C, dtype),
+            "bo": jnp.zeros((C,), dtype),
+        }
+
+    def _attend(self, q, k, v, kmask=None):
+        from deeplearning4j_tpu.parallel.ring import local_attention, ring_self_attention
+
+        if self.sequence_parallel and _mesh_has_axis("seq"):
+            from deeplearning4j_tpu.parallel.context import current_mesh
+
+            mesh = current_mesh()
+            # tp+sp composition: when heads are tensor-parallel (column-sharded
+            # Wqkv) and divide evenly, keep the head axis sharded through the
+            # ring kernel instead of all-gathering activations over "model".
+            head_axis = (
+                "model"
+                if ("model" in mesh.shape and mesh.shape["model"] > 1
+                    and q.shape[2] % mesh.shape["model"] == 0)
+                else None
+            )
+            return ring_self_attention(
+                q, k, v, mesh, causal=self.causal, kmask=kmask, head_axis=head_axis
+            )
+        return local_attention(q, k, v, causal=self.causal, kmask=kmask)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        B, T, C = x.shape
+        H = self.n_heads
+        qkv = x @ params["Wqkv"] + params["bqkv"]
+        q, k, v = jnp.split(qkv.reshape(B, T, 3 * H, C // H), 3, axis=2)
+        kmask = None
+        if mask is not None and mask.ndim >= 2:
+            kmask = mask.reshape(B, T)  # [B,T] key validity from feature mask
+        out = self._attend(q, k, v, kmask)  # [B,T,H,D]
+        out = out.reshape(B, T, C)
+        if train and self.attn_dropout > 0.0 and rng is not None:
+            keep = 1.0 - self.attn_dropout
+            out = jnp.where(jax.random.bernoulli(rng, keep, out.shape), out / keep, 0.0)
+        return out @ params["Wo"] + params["bo"], state
+
+
+@register_layer("transformer_block")
+@dataclass
+class TransformerBlock(LayerConfig):
+    """Pre-LN transformer block: x + MHA(LN(x)); x + MLP(LN(x)).
+
+    MLP is a fused [C,4C]→gelu→[4C,C] pair (``ffn_mult`` configurable).
+    """
+
+    n_heads: int = 8
+    ffn_mult: int = 4
+    causal: bool = True
+    sequence_parallel: bool = False
+    activation: Any = "gelu"
+    weight_init: Any = "xavier"
+    eps: float = 1e-5
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def _mha(self) -> MultiHeadAttention:
+        return MultiHeadAttention(
+            n_heads=self.n_heads,
+            causal=self.causal,
+            sequence_parallel=self.sequence_parallel,
+            weight_init=self.weight_init,
+        )
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        C = input_type.size
+        F = self.ffn_mult * C
+        k1, k2, k3 = jax.random.split(key, 3)
+        return {
+            "attn": self._mha().init(k1, input_type, dtype),
+            "ln1": {"gamma": jnp.ones((C,), dtype), "beta": jnp.zeros((C,), dtype)},
+            "ln2": {"gamma": jnp.ones((C,), dtype), "beta": jnp.zeros((C,), dtype)},
+            "Wi": initializers.initialize(self.weight_init, k2, (C, F), C, F, dtype),
+            "bi": jnp.zeros((F,), dtype),
+            "Wo": initializers.initialize(self.weight_init, k3, (F, C), F, C, dtype),
+            "bo": jnp.zeros((C,), dtype),
+        }
+
+    def _ln(self, p, x):
+        from deeplearning4j_tpu.nn.layers.normalization import layer_norm
+
+        return layer_norm(x, p["gamma"], p["beta"], self.eps)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        h = self._ln(params["ln1"], x)
+        a, _ = self._mha().apply(params["attn"], {}, h, train=train, rng=rng, mask=mask)
+        x = x + a
+        h = self._ln(params["ln2"], x)
+        h = self.activation_fn()(h @ params["Wi"] + params["bi"])
+        return x + (h @ params["Wo"] + params["bo"]), state

@@ -1,0 +1,97 @@
+"""Mixture-of-Experts FFN layer (expert parallelism).
+
+Beyond-reference capability. Switch-transformer-style top-1 routing with a
+fixed per-expert capacity so every shape is static under jit: tokens are
+dispatched to [E, capacity, C] expert buffers with one einsum, each expert
+runs a batched FFN (one [E,·,·] batched matmul pair → MXU), and results
+combine back weighted by the router gate. Overflow tokens (beyond capacity)
+pass through the residual unchanged — the standard capacity-drop policy.
+
+Expert parallelism = sharding the leading E axis of the expert weights over
+the mesh's ``model`` axis (see parallel/tp.py); XLA turns the dispatch
+einsums into all-to-alls over ICI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import initializers
+from deeplearning4j_tpu.nn.config import LayerConfig, register_layer
+from deeplearning4j_tpu.nn.input_type import InputType
+
+
+@register_layer("mixture_of_experts")
+@dataclass
+class MixtureOfExperts(LayerConfig):
+    """Top-1 (switch) MoE over [B, T, C] token streams, residual style:
+    ``y = x + combine(expert_ffn(dispatch(x)))``."""
+
+    n_experts: int = 8
+    ffn_mult: int = 4
+    capacity_factor: float = 1.25
+    activation: Any = "gelu"
+    weight_init: Any = "xavier"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        C = input_type.size
+        F = self.ffn_mult * C
+        E = self.n_experts
+        kg, ki, ko = jax.random.split(key, 3)
+        init = lambda k, shape, fi, fo: initializers.initialize(
+            self.weight_init, k, shape, fi, fo, dtype
+        )
+        return {
+            "Wg": init(kg, (C, E), C, E),
+            "Wi": jnp.stack([init(k, (C, F), C, F) for k in jax.random.split(ki, E)]),
+            "bi": jnp.zeros((E, F), dtype),
+            "Wo": jnp.stack([init(k, (F, C), F, C) for k in jax.random.split(ko, E)]),
+            "bo": jnp.zeros((E, C), dtype),
+        }
+
+    def _capacity(self, n_tokens: int) -> int:
+        cap = int(self.capacity_factor * n_tokens / self.n_experts)
+        return max(cap, 1)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        B, T, C = x.shape
+        E = self.n_experts
+        N = B * T
+        cap = self._capacity(N)
+        xt = x.reshape(N, C)
+
+        # Routing math runs in f32/int32 regardless of activation dtype:
+        # a bf16 cumsum loses integer precision past 256 and collides slots.
+        logits = (xt @ params["Wg"]).astype(jnp.float32)            # [N,E]
+        gates = jax.nn.softmax(logits, axis=-1)
+        expert = jnp.argmax(gates, axis=-1)             # [N]
+        gate = jnp.max(gates, axis=-1).astype(x.dtype)  # [N]
+        onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)       # [N,E]
+        pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0             # slot per token
+        keep = (pos >= 0) & (pos < cap)
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32) * keep.astype(jnp.float32)[..., None]
+        dispatch = (onehot[..., None] * slot).astype(x.dtype)       # [N,E,cap]
+
+        xe = jnp.einsum("nec,nd->ecd", dispatch, xt)    # [E,cap,C]
+        he = self.activation_fn()(jnp.einsum("ecd,edf->ecf", xe, params["Wi"]) + params["bi"][:, None])
+        ye = jnp.einsum("ecf,efd->ecd", he, params["Wo"]) + params["bo"][:, None]
+        combine = dispatch * gate[:, None, None]        # gate-weighted routes
+        yt = jnp.einsum("nec,ecd->nd", combine, ye)
+        return x + yt.reshape(B, T, C), state
+
+    def load_balance_loss(self, params, x) -> jax.Array:
+        """Auxiliary load-balancing loss (Switch §2.2): E · Σ_e f_e · P_e."""
+        N = x.shape[0] * x.shape[1]
+        logits = (x.reshape(N, -1) @ params["Wg"]).astype(jnp.float32)
+        gates = jax.nn.softmax(logits, axis=-1)
+        frac = jnp.mean(jax.nn.one_hot(jnp.argmax(gates, -1), self.n_experts), axis=0)
+        prob = jnp.mean(gates, axis=0)
+        return self.n_experts * jnp.sum(frac * prob)

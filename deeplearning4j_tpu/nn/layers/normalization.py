@@ -100,3 +100,46 @@ class LocalResponseNormalization(LayerConfig):
         ssum = lax.reduce_window(sq, 0.0, lax.add, window, strides, pads)
         denom = (self.k + self.alpha * ssum) ** self.beta
         return x / denom, state
+
+
+def layer_norm(x, gamma=None, beta=None, eps: float = 1e-5):
+    """Functional layer norm over the last axis (shared by LayerNorm and
+    TransformerBlock). Statistics in f32 for bf16 inputs (stability), result
+    cast back to the input dtype."""
+    dt = x.dtype
+    xs = x.astype(jnp.float32) if dt == jnp.bfloat16 else x
+    mean = jnp.mean(xs, axis=-1, keepdims=True)
+    var = jnp.mean((xs - mean) ** 2, axis=-1, keepdims=True)
+    y = (xs - mean) * lax.rsqrt(var + eps)
+    y = y.astype(dt)
+    if gamma is not None:
+        y = y * gamma + beta
+    return y
+
+
+@register_layer("layer_norm")
+@dataclass
+class LayerNorm(LayerConfig):
+    """Layer normalization over the last (feature) axis.
+
+    Beyond-reference capability (the reference has no transformer stack);
+    required by the attention/transformer layers (attention.py). One fused
+    reduce+elementwise graph under XLA.
+    """
+
+    eps: float = 1e-5
+    use_gamma_beta: bool = True
+
+    def _nfeat(self, input_type: InputType) -> int:
+        return input_type.channels if input_type.kind == "conv" else input_type.size
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        if not self.use_gamma_beta:
+            return {}
+        n = self._nfeat(input_type)
+        return {"gamma": jnp.ones((n,), dtype), "beta": jnp.zeros((n,), dtype)}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        g = params.get("gamma") if params else None
+        b = params.get("beta") if params else None
+        return layer_norm(x, g, b, self.eps), state

@@ -98,6 +98,23 @@ class MultiLayerConfiguration:
         return MultiLayerConfiguration.from_dict(json.loads(s))
 
 
+def _cast_input(x, dtype):
+    """Cast a feature array to the model dtype, PRESERVING (a) integer/bool
+    dtypes (token ids must not round-trip through bf16 — ids >256 would
+    corrupt) and (b) float64 arrays (the x64 gradient-check path drives the
+    model at double precision on purpose)."""
+    if x is None:
+        return None
+    x = jnp.asarray(x)
+    if (
+        jnp.issubdtype(x.dtype, jnp.integer)
+        or x.dtype == jnp.bool_
+        or x.dtype == jnp.float64
+    ):
+        return x
+    return x.astype(dtype)
+
+
 def _as_batch(batch):
     """Normalize a batch to (features, labels, features_mask, labels_mask).
 
@@ -236,7 +253,7 @@ class MultiLayerNetwork:
         new_state = list(state)
         new_carries = list(carries) if carries is not None else None
         mask = fmask
-        a = jnp.asarray(x, self.dtype) if not isinstance(x, jax.Array) else x
+        a = _cast_input(x, self.dtype)
         for i in range(n):
             layer = self.layers[i]
             lrng = rngs[i] if rngs is not None else None
@@ -365,7 +382,7 @@ class MultiLayerNetwork:
         """One step. Returns the loss as a DEVICE scalar — callers decide
         whether to sync (fit() only syncs when listeners are attached)."""
         step = self._get_step_fn(False)
-        x = jnp.asarray(x, self.dtype)
+        x = _cast_input(x, self.dtype)
         y = jnp.asarray(y, self.dtype) if y is not None else None
         fm = jnp.asarray(fm, self.dtype) if fm is not None else None
         lm = jnp.asarray(lm, self.dtype) if lm is not None else None
@@ -419,7 +436,7 @@ class MultiLayerNetwork:
 
             self._output_fn = jax.jit(fwd)
         return self._output_fn(self.params, self.state,
-                               jnp.asarray(x, self.dtype),
+                               _cast_input(x, self.dtype),
                                jnp.asarray(fmask, self.dtype) if fmask is not None else None)
 
     def predict(self, x) -> np.ndarray:
@@ -433,7 +450,7 @@ class MultiLayerNetwork:
             x = batch_or_x
         loss, _ = self._loss(
             self.params, self.state,
-            jnp.asarray(x, self.dtype), jnp.asarray(y, self.dtype),
+            _cast_input(x, self.dtype), jnp.asarray(y, self.dtype),
             jnp.asarray(fmask, self.dtype) if fmask is not None else None,
             jnp.asarray(lmask, self.dtype) if lmask is not None else None,
             rngs=None,
@@ -486,7 +503,7 @@ class MultiLayerNetwork:
     # -- streaming RNN inference (rnnTimeStep:2371) ------------------------
     def rnn_time_step(self, x):
         """Feed one or more timesteps, carrying RNN state between calls."""
-        x = jnp.asarray(x, self.dtype)
+        x = _cast_input(x, self.dtype)
         squeeze = x.ndim == 2
         if squeeze:
             x = x[:, None, :]

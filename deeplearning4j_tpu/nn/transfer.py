@@ -84,6 +84,7 @@ class TransferLearningBuilder:
         self._layers: List[Any] = list(model.conf.layers)
         self._ftc: Optional[FineTuneConfiguration] = None
         self._freeze_until: Optional[int] = None
+        self._reinit: set = set()  # indices whose params must NOT transfer
 
     def fine_tune_configuration(self, ftc: FineTuneConfiguration):
         self._ftc = ftc
@@ -102,11 +103,16 @@ class TransferLearningBuilder:
         if weight_init is not None:
             kw["weight_init"] = weight_init
         self._layers[layer_idx] = dataclasses.replace(layer, **kw)
+        # The replaced layer (and its successor, whose n_in depends on it) is
+        # ALWAYS re-initialized, even when the new n_out equals the old one —
+        # reference nOutReplace semantics.
+        self._reinit.add(layer_idx)
         if layer_idx + 1 < len(self._layers) and hasattr(self._layers[layer_idx + 1], "n_in"):
             # clear explicit n_in so it re-infers from the new n_out
             self._layers[layer_idx + 1] = dataclasses.replace(
                 self._layers[layer_idx + 1], n_in=None
             )
+            self._reinit.add(layer_idx + 1)
         return self
 
     def remove_output_layer(self):
@@ -141,8 +147,21 @@ class TransferLearningBuilder:
             tbptt_back_length=self._model.conf.tbptt_back_length,
         )
         new = MultiLayerNetwork(MultiLayerConfiguration(**conf_kw)).init()
+        # resolved indices of layers marked for re-initialization (config
+        # indices shift when auto-preprocessors are interleaved; preprocessor
+        # type tags are "pp_*")
+        no_transfer = set()
+        cfg_i = 0
+        for r, l in enumerate(new.layers):
+            if l._type_name.startswith("pp_"):
+                continue
+            if cfg_i in self._reinit:
+                no_transfer.add(r)
+            cfg_i += 1
         # shape-matched positional param transfer over the resolved stacks
         for i in range(min(len(new.params), len(self._model.params))):
+            if i in no_transfer:
+                continue
             if _tree_shapes_match(new.params[i], self._model.params[i]):
                 new.params = new.params[:i] + (
                     jax.tree_util.tree_map(jnp.copy, self._model.params[i]),
@@ -298,11 +317,11 @@ class TransferLearningHelper:
 
     def featurize(self, batch):
         """(x, y, ...) -> (features_at_boundary, y, ...)."""
-        from deeplearning4j_tpu.nn.model import _as_batch
+        from deeplearning4j_tpu.nn.model import _as_batch, _cast_input
 
         x, y, fm, lm = _as_batch(batch)
         a, _, _, mask, _ = self._model._forward(
-            self._model.params, self._model.state, jnp.asarray(x, self._model.dtype),
+            self._model.params, self._model.state, _cast_input(x, self._model.dtype),
             train=False, rngs=None,
             fmask=jnp.asarray(fm, self._model.dtype) if fm is not None else None,
             upto=self._boundary,

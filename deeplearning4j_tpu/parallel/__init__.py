@@ -13,5 +13,14 @@ bf16/f32 all-reduce cheaper than encode/decode (SURVEY.md §5.8).
 from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.parallel.context import current_mesh, use_mesh
+from deeplearning4j_tpu.parallel.ring import local_attention, ring_self_attention
+from deeplearning4j_tpu.parallel.pipeline import PipelineParallel, stack_stage_params
+from deeplearning4j_tpu.parallel.tp import ShardedTrainer, tp_param_shardings
 
-__all__ = ["MeshSpec", "make_mesh", "ParallelWrapper", "ParallelInference"]
+__all__ = [
+    "MeshSpec", "make_mesh", "ParallelWrapper", "ParallelInference",
+    "current_mesh", "use_mesh", "local_attention", "ring_self_attention",
+    "PipelineParallel", "stack_stage_params", "ShardedTrainer",
+    "tp_param_shardings",
+]

@@ -1,0 +1,31 @@
+"""Active-mesh context.
+
+Layers that can exploit mesh axes (ring attention over ``seq``, expert
+dispatch over ``model``) look the mesh up here instead of threading it
+through every ``apply`` signature. ``use_mesh`` is re-entrant and
+trace-safe: it only sets a module-level variable read at trace time.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+from jax.sharding import Mesh
+
+_ACTIVE_MESH: Optional[Mesh] = None
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _ACTIVE_MESH
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Optional[Mesh]):
+    global _ACTIVE_MESH
+    prev = _ACTIVE_MESH
+    _ACTIVE_MESH = mesh
+    try:
+        yield mesh
+    finally:
+        _ACTIVE_MESH = prev

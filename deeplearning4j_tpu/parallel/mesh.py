@@ -24,30 +24,35 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 @dataclass(frozen=True)
 class MeshSpec:
-    """Logical mesh shape. -1 on one axis means 'all remaining devices'."""
+    """Logical mesh shape. -1 on one axis means 'all remaining devices'.
+
+    Axes: ``data`` (dp), ``model`` (tp — and ep: expert weights shard their
+    expert axis here), ``seq`` (sp — ring attention), ``pipe`` (pp).
+    """
 
     data: int = -1
     model: int = 1
     seq: int = 1
+    pipe: int = 1
 
-    def resolve(self, n_devices: int) -> Tuple[int, int, int]:
-        d, m, s = self.data, self.model, self.seq
-        fixed = (m if m > 0 else 1) * (s if s > 0 else 1)
+    def resolve(self, n_devices: int) -> Tuple[int, int, int, int]:
+        d, m, s, p = self.data, self.model, self.seq, self.pipe
+        fixed = max(m, 1) * max(s, 1) * max(p, 1)
         if d == -1:
             d = n_devices // fixed
-        if d * m * s != n_devices:
+        if d * m * s * p != n_devices:
             raise ValueError(
-                f"MeshSpec {d}x{m}x{s} does not cover {n_devices} devices"
+                f"MeshSpec {d}x{m}x{s}x{p} does not cover {n_devices} devices"
             )
-        return d, m, s
+        return d, m, s, p
 
 
 def make_mesh(spec: Optional[MeshSpec] = None, devices: Optional[Sequence] = None) -> Mesh:
     devices = list(devices) if devices is not None else jax.devices()
     spec = spec or MeshSpec()
-    d, m, s = spec.resolve(len(devices))
-    arr = np.array(devices).reshape(d, m, s)
-    return Mesh(arr, ("data", "model", "seq"))
+    d, m, s, p = spec.resolve(len(devices))
+    arr = np.array(devices).reshape(d, m, s, p)
+    return Mesh(arr, ("data", "model", "seq", "pipe"))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

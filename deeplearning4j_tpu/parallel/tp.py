@@ -1,0 +1,169 @@
+"""Tensor parallelism: PartitionSpec rules + the sharded trainer.
+
+Megatron-style sharding expressed as metadata, not code: each layer type
+maps its param names to PartitionSpecs over the mesh's ``model`` axis
+(column-parallel in-projections, row-parallel out-projections); XLA/GSPMD
+inserts the psum/all-gathers over ICI during compilation. Expert weights
+(MixtureOfExperts) shard their leading E axis over the same axis = expert
+parallelism.
+
+``ShardedTrainer`` composes every axis: params placed per TP rules, batch
+sharded over ``data``, the time axis of sequence inputs over ``seq`` (ring
+attention picks the axis up via parallel/context.py), all inside the ONE
+jitted train step the single-chip path uses.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.context import use_mesh
+
+
+def _spec_for(layer, pname: str, value, model_axis: str) -> P:
+    """TP PartitionSpec for one param of one layer (replicated fallback)."""
+    t = getattr(layer, "_type_name", "")
+    if t == "multi_head_attention":
+        return {
+            "Wqkv": P(None, model_axis),  # column-parallel heads
+            "bqkv": P(model_axis),
+            "Wo": P(model_axis, None),    # row-parallel out-proj
+            "bo": P(),
+        }.get(pname, P())
+    if t == "transformer_block":
+        return {
+            "Wi": P(None, model_axis),
+            "bi": P(model_axis),
+            "Wo": P(model_axis, None),
+            "bo": P(),
+        }.get(pname, P())
+    if t == "mixture_of_experts":
+        # expert parallelism: shard the expert axis
+        if pname in ("Wi", "bi", "Wo", "bo"):
+            return P(model_axis)
+        return P()
+    if t in ("dense", "output") and pname == "W" and np.prod(value.shape) >= 1 << 16:
+        return P(None, model_axis)  # shard big FF matrices column-wise
+    if t in ("embedding", "embedding_sequence") and pname == "W":
+        return P(None, model_axis)  # shard embedding features
+    return P()
+
+
+def tp_param_shardings(model, mesh: Mesh, model_axis: str = "model"):
+    """Per-param NamedShardings for a MultiLayerNetwork's params pytree."""
+
+    def layer_specs(layer, params):
+        def walk(sub, owner):
+            out = {}
+            for name, v in sub.items():
+                if isinstance(v, dict):
+                    # nested block (e.g. TransformerBlock."attn" is MHA params)
+                    inner_owner = owner
+                    if name == "attn":
+                        from deeplearning4j_tpu.nn.layers.attention import MultiHeadAttention
+
+                        inner_owner = MultiHeadAttention()
+                    out[name] = walk(v, inner_owner)
+                else:
+                    out[name] = NamedSharding(mesh, _spec_for(owner, name, v, model_axis))
+            return out
+
+        return walk(params, layer)
+
+    return tuple(layer_specs(l, p) for l, p in zip(model.layers, model.params))
+
+
+class ShardedTrainer:
+    """Drives a MultiLayerNetwork's jitted step over a dp×tp×sp mesh.
+
+    - params: placed per TP/EP rules (tp_param_shardings)
+    - batch axis 0: sharded over ``data``
+    - time axis 1 (rank-3 inputs): sharded over ``seq`` when the mesh has one
+    - ring attention engages automatically for layers configured with
+      ``sequence_parallel=True`` (mesh published via parallel.context)
+    """
+
+    def __init__(self, model, mesh: Mesh, *, shard_time: bool = True):
+        self.model = model
+        self.mesh = mesh
+        self.shard_time = shard_time and "seq" in mesh.shape and mesh.shape["seq"] > 1
+        if model.params is None:
+            model.init()
+        self._place_params()
+
+    def _place_params(self):
+        m = self.model
+        shardings = tp_param_shardings(m, self.mesh)
+        m.params = jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(a, s), m.params, shardings,
+            is_leaf=lambda x: isinstance(x, jax.Array),
+        )
+        repl = NamedSharding(self.mesh, P())
+        m.state = jax.tree_util.tree_map(lambda a: jax.device_put(a, repl), m.state)
+        # opt state mirrors param shardings: each slot ("m"/"v"/…) is a
+        # params-like tree, so moment tensors shard exactly like their params
+        new_opt = []
+        for opt_layer, shard_layer in zip(m.opt_state, shardings):
+            if not isinstance(opt_layer, dict):  # stateless updater (sgd/noop)
+                new_opt.append(jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a, repl), opt_layer))
+                continue
+            placed = {}
+            for slot, tree in opt_layer.items():
+                try:
+                    placed[slot] = jax.tree_util.tree_map(
+                        lambda a, s: jax.device_put(a, s), tree, shard_layer
+                    )
+                except ValueError:  # structure mismatch (scalar/extra state)
+                    placed[slot] = jax.tree_util.tree_map(
+                        lambda a: jax.device_put(a, repl), tree
+                    )
+            new_opt.append(placed)
+        m.opt_state = tuple(new_opt)
+        # Cached step/output fns may have been traced WITHOUT the mesh
+        # context (no ring attention) — force a retrace under the mesh.
+        m._step_fn = m._tbptt_step_fn = m._output_fn = None
+
+    def _shard_batch(self, arr, is_seq: bool):
+        if arr is None:
+            return None
+        from deeplearning4j_tpu.nn.model import _cast_input
+
+        arr = _cast_input(arr, self.model.dtype)
+        axes = ["data"] + (["seq"] if (is_seq and arr.ndim >= 3 and self.shard_time) else [])
+        spec = P(*axes, *([None] * (arr.ndim - len(axes))))
+        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+
+    def fit_batch(self, x, y, fmask=None, lmask=None):
+        """One sharded training step; returns the loss (device scalar)."""
+        with use_mesh(self.mesh):
+            return self.model._fit_batch(
+                self._shard_batch(x, True),
+                self._shard_batch(y, True),
+                self._shard_batch(fmask, True),
+                self._shard_batch(lmask, True),
+            )
+
+    def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None):
+        from deeplearning4j_tpu.nn.model import _iter_batches
+
+        model = self.model
+        for _ in range(epochs):
+            source = data() if callable(data) else data
+            for xb, yb, fm, lm in _iter_batches(source, batch_size):
+                score = self.fit_batch(xb, yb, fm, lm)
+                if model.listeners:
+                    score = float(score)
+                    for l in model.listeners:
+                        l.iteration_done(model, model.iteration, score, len(xb))
+            model.epoch += 1
+        return model
+
+    def output(self, x):
+        with use_mesh(self.mesh):
+            return self.model.output(self._shard_batch(x, True))

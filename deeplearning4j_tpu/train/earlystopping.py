@@ -359,9 +359,18 @@ class EarlyStoppingTrainer:
 
         best_model = cfg.model_saver.get_best_model()
         if best_model is None:
-            best_model = model
-            best_epoch = epoch - 1
-            best_score = score_vs_epoch.get(epoch - 1, math.inf)
+            if score_vs_epoch:
+                # no saver capture but epochs were scored: current model stands
+                best_model = model
+                best_epoch = epoch - 1
+                best_score = score_vs_epoch.get(epoch - 1, math.inf)
+            else:
+                # terminated before ANY epoch completed (e.g. divergence mid
+                # epoch 0): there is no best model — do not present the
+                # possibly-NaN current weights as one
+                best_model = None
+                best_epoch = -1
+                best_score = math.inf
         return EarlyStoppingResult(
             termination_reason=reason,
             termination_details=details,

@@ -37,7 +37,7 @@ class TestMesh:
         mesh = make_mesh(MeshSpec(data=8))
         assert mesh.shape["data"] == 8
         mesh = make_mesh(MeshSpec(data=4, model=2))
-        assert mesh.shape == {"data": 4, "model": 2, "seq": 1}
+        assert mesh.shape == {"data": 4, "model": 2, "seq": 1, "pipe": 1}
 
     def test_bad_spec_raises(self):
         with pytest.raises(ValueError):
