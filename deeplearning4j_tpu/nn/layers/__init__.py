@@ -35,6 +35,19 @@ from deeplearning4j_tpu.nn.layers.attention import (
     TransformerBlock,
 )
 from deeplearning4j_tpu.nn.layers.moe import MixtureOfExperts
+from deeplearning4j_tpu.nn.layers.variational import VariationalAutoencoder
+from deeplearning4j_tpu.nn.layers.objdetect import (
+    Yolo2OutputLayer,
+    get_predicted_objects,
+    non_max_suppression,
+)
+from deeplearning4j_tpu.nn.layers.custom import (
+    CenterLossOutputLayer,
+    CnnLossLayer,
+    CustomLayer,
+    FrozenLayer,
+    LambdaLayer,
+)
 from deeplearning4j_tpu.nn.layers.pooling import GlobalPooling
 from deeplearning4j_tpu.nn.layers.recurrent import (
     Bidirectional,
@@ -73,6 +86,15 @@ __all__ = [
     "PositionalEmbedding",
     "TransformerBlock",
     "MixtureOfExperts",
+    "VariationalAutoencoder",
+    "Yolo2OutputLayer",
+    "get_predicted_objects",
+    "non_max_suppression",
+    "CenterLossOutputLayer",
+    "CnnLossLayer",
+    "CustomLayer",
+    "FrozenLayer",
+    "LambdaLayer",
     "LocalResponseNormalization",
     "GlobalPooling",
     "Bidirectional",

@@ -108,7 +108,8 @@ class MultiHeadAttention(LayerConfig):
         return local_attention(q, k, v, causal=self.causal, kmask=kmask)
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
-        x = self.maybe_dropout_input(x, train, rng)
+        rng_in, rng_attn = (jax.random.split(rng) if rng is not None else (None, None))
+        x = self.maybe_dropout_input(x, train, rng_in)
         B, T, C = x.shape
         H = self.n_heads
         qkv = x @ params["Wqkv"] + params["bqkv"]
@@ -118,9 +119,9 @@ class MultiHeadAttention(LayerConfig):
             kmask = mask.reshape(B, T)  # [B,T] key validity from feature mask
         out = self._attend(q, k, v, kmask)  # [B,T,H,D]
         out = out.reshape(B, T, C)
-        if train and self.attn_dropout > 0.0 and rng is not None:
+        if train and self.attn_dropout > 0.0 and rng_attn is not None:
             keep = 1.0 - self.attn_dropout
-            out = jnp.where(jax.random.bernoulli(rng, keep, out.shape), out / keep, 0.0)
+            out = jnp.where(jax.random.bernoulli(rng_attn, keep, out.shape), out / keep, 0.0)
         return out @ params["Wo"] + params["bo"], state
 
 
@@ -171,9 +172,10 @@ class TransformerBlock(LayerConfig):
         return layer_norm(x, p["gamma"], p["beta"], self.eps)
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
-        x = self.maybe_dropout_input(x, train, rng)
+        rng_in, rng_attn = (jax.random.split(rng) if rng is not None else (None, None))
+        x = self.maybe_dropout_input(x, train, rng_in)
         h = self._ln(params["ln1"], x)
-        a, _ = self._mha().apply(params["attn"], {}, h, train=train, rng=rng, mask=mask)
+        a, _ = self._mha().apply(params["attn"], {}, h, train=train, rng=rng_attn, mask=mask)
         x = x + a
         h = self._ln(params["ln2"], x)
         h = self.activation_fn()(h @ params["Wi"] + params["bi"])

@@ -453,6 +453,8 @@ class ZeroPadding2D(LayerConfig):
 class Cropping2D(LayerConfig):
     """Spatial cropping (Cropping2D.java). crop: (top, bottom, left, right)."""
 
+    CONSUMES_CONV = True
+
     crop: Any = (0, 0, 0, 0)
 
     def _crops(self):

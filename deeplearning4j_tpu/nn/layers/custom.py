@@ -1,0 +1,161 @@
+"""Custom-layer API + misc heads: the SameDiff-layer equivalent, LambdaLayer,
+FrozenLayer, CenterLossOutputLayer, CnnLossLayer.
+
+Reference parity:
+- nn/conf/layers/samediff/AbstractSameDiffLayer.java + SameDiffLayer.java —
+  user-defined layers. Here the whole framework already IS "define forward,
+  autodiff the rest", so the custom-layer API is just the LayerConfig
+  contract: subclass ``CustomLayer``, implement ``init``/``forward``,
+  decorate with ``@register_layer`` for JSON serde.
+- SameDiffLambdaLayer → ``LambdaLayer`` (stateless function).
+- nn/conf/layers/misc/FrozenLayer.java → ``FrozenLayer`` wrapper.
+- nn/conf/layers/CenterLossOutputLayer.java → ``CenterLossOutputLayer``.
+- nn/conf/layers/CnnLossLayer.java → ``CnnLossLayer``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import initializers, losses
+from deeplearning4j_tpu.nn.config import FeedForwardLayerConfig, LayerConfig, register_layer
+from deeplearning4j_tpu.nn.input_type import InputType
+
+
+class CustomLayer(LayerConfig):
+    """Base class for user-defined layers (SameDiff-layer equivalent).
+
+    Subclass, implement ``init`` (params pytree) and ``forward`` (pure
+    function of (params, x)); backward is autodiff. Register with
+    ``@register_layer("my_type")`` to make configs JSON round-trippable.
+    """
+
+    def forward(self, params, x):
+        raise NotImplementedError
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return self.forward(params, x), state
+
+
+@register_layer("lambda")
+@dataclass
+class LambdaLayer(LayerConfig):
+    """Stateless function layer (SameDiffLambdaLayer equivalent). The
+    function does not survive JSON round-trips (same limitation as the
+    reference, which needs the class on the classpath)."""
+
+    fn: Optional[Callable] = None
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        if self.fn is None:
+            raise ValueError("LambdaLayer.fn missing (not restorable from JSON)")
+        return self.fn(x), state
+
+
+@register_layer("frozen")
+@dataclass
+class FrozenLayer(LayerConfig):
+    """Wrapper excluding the inner layer's params from training
+    (nn/conf/layers/misc/FrozenLayer.java). Equivalent to
+    ``dataclasses.replace(inner, trainable=False)`` — provided for API parity
+    with transfer learning surgery."""
+
+    inner: Optional[LayerConfig] = None
+
+    def __post_init__(self):
+        self.trainable = False
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return self.inner.output_type(input_type)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        return self.inner.init(key, input_type, dtype)
+
+    def init_state(self, input_type: InputType):
+        return self.inner.init_state(input_type)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        # inference-mode inner apply: frozen layers don't update BN stats etc.
+        y, _ = self.inner.apply(params, state, x, train=False, rng=rng, mask=mask)
+        return y, state
+
+    def propagate_mask(self, mask, input_type):
+        return self.inner.propagate_mask(mask, input_type)
+
+    def score(self, params, x, labels, mask=None, average=True, weights=None):
+        return self.inner.score(params, x, labels, mask=mask, average=average, weights=weights)
+
+
+@register_layer("center_loss_output")
+@dataclass
+class CenterLossOutputLayer(FeedForwardLayerConfig):
+    """Softmax output + center loss (CenterLossOutputLayer.java): pulls each
+    example's PRE-output features toward its class center.
+
+    ``alpha`` scales the center-update speed; here centers are parameters
+    whose gradient from the center term is exactly the (feature - center)
+    EMA direction the reference applies by hand, so plain SGD/Adam on them
+    reproduces the behavior. ``lambda_`` weights the center term.
+    """
+
+    alpha: float = 0.05
+    lambda_: float = 2e-4
+    loss: Any = "mcxent"
+    activation: Any = "softmax"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        n_in = self.n_in if self.n_in is not None else input_type.flat_size()
+        kW, _ = jax.random.split(key)
+        return {
+            "W": initializers.initialize(self.weight_init, kW, (n_in, self.n_out), n_in, self.n_out, dtype),
+            "b": jnp.full((self.n_out,), self.bias_init, dtype),
+            "centers": jnp.zeros((self.n_out, n_in), dtype),
+        }
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        y = x @ params["W"] + params["b"]
+        return self.activation_fn()(y), state
+
+    def score(self, params, x, labels, mask=None, average=True, weights=None):
+        preact = x @ params["W"] + params["b"]
+        base = losses.average_score(self.loss, labels, preact, self.activation, mask, weights)
+        centers_for = labels @ params["centers"]  # one-hot labels pick rows
+        center_term = 0.5 * jnp.mean(jnp.sum((x - centers_for) ** 2, axis=-1))
+        # alpha folds into the centers' learning rate via the term scale
+        return base + self.lambda_ * self.alpha / 0.05 * center_term
+
+    BIAS_PARAM_NAMES = frozenset({"b", "centers"})  # centers: no l1/l2
+
+
+@register_layer("cnn_loss")
+@dataclass
+class CnnLossLayer(LayerConfig):
+    """Per-pixel loss head for dense prediction / segmentation
+    (CnnLossLayer.java): activation + loss applied at every spatial position
+    of [B, H, W, C]; 2D masks broadcast over channels."""
+
+    CONSUMES_CONV = True  # takes [b,h,w,c] natively (no auto-flatten)
+
+    activation: Any = "identity"
+    loss: Any = "mcxent"
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return self.activation_fn()(x), state
+
+    def score(self, params, x, labels, mask=None, average=True, weights=None):
+        B, H, W, C = x.shape
+        flat_x = x.reshape(B * H * W, C)
+        flat_y = labels.reshape(B * H * W, C)
+        flat_m = mask.reshape(-1) if mask is not None else None
+        if average:
+            return losses.average_score(self.loss, flat_y, flat_x, self.activation, flat_m, weights)
+        per = losses.per_example_scores(self.loss, flat_y, flat_x, self.activation, flat_m, weights)
+        return per.reshape(B, H, W)

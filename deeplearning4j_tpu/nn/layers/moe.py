@@ -75,6 +75,10 @@ class MixtureOfExperts(LayerConfig):
         expert = jnp.argmax(gates, axis=-1)             # [N]
         gate = jnp.max(gates, axis=-1).astype(x.dtype)  # [N]
         onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)       # [N,E]
+        if mask is not None and mask.ndim >= 2:
+            # padding tokens don't route: they must not consume expert
+            # capacity (slots are position-ordered) nor receive expert output
+            onehot = onehot * mask.reshape(N).astype(jnp.float32)[:, None]
         pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0             # slot per token
         keep = (pos >= 0) & (pos < cap)
         slot = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32) * keep.astype(jnp.float32)[..., None]

@@ -1,0 +1,183 @@
+"""YOLOv2 object-detection output layer + box utilities.
+
+Capability parity with the reference's
+nn/conf/layers/objdetect/Yolo2OutputLayer.java +
+nn/layers/objdetect/Yolo2OutputLayer.java:71 and YoloUtils (box decoding,
+IOU, non-max suppression). TPU-first: the loss is one fused graph over the
+[B, H, W, A*(5+C)] prediction grid (NHWC — the reference uses NCHW);
+NMS runs host-side on decoded detections (it is inference-only plumbing).
+
+Label format (same capability as the reference's): [B, H, W, 4 + C] per-cell
+ground truth: (x1, y1, x2, y2) in GRID units + one-hot class, with an
+objectness indicator derived from the class vector (cells with no object are
+all-zero).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.config import LayerConfig, register_layer
+from deeplearning4j_tpu.nn.input_type import InputType
+
+
+@register_layer("yolo2_output")
+@dataclass
+class Yolo2OutputLayer(LayerConfig):
+    """YOLOv2 loss head. ``boxes``: anchor priors [(w, h), ...] in grid units.
+
+    lambda_coord / lambda_no_obj follow the reference defaults (5.0, 0.5).
+    """
+
+    CONSUMES_CONV = True  # takes [b,h,w,c] natively (no auto-flatten)
+
+    boxes: Tuple[Tuple[float, float], ...] = ((1.0, 1.0),)
+    lambda_coord: float = 5.0
+    lambda_no_obj: float = 0.5
+
+    @property
+    def n_anchors(self) -> int:
+        return len(self.boxes)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return x, state  # raw grid passes through; decode via yolo_activate
+
+    # -- decoding ----------------------------------------------------------
+    def _split_grid(self, x, n_classes):
+        """[B,H,W,A*(5+C)] -> (xy, wh, conf, class_logits)."""
+        B, H, W, _ = x.shape
+        A = self.n_anchors
+        g = x.reshape(B, H, W, A, 5 + n_classes)
+        return g[..., 0:2], g[..., 2:4], g[..., 4], g[..., 5:]
+
+    def activate(self, x, n_classes):
+        """Network output -> interpretable grid: sigmoid xy offsets, anchor-
+        scaled wh, sigmoid objectness, softmax class probs (YoloUtils.activate)."""
+        xy, wh, conf, cls = self._split_grid(x, n_classes)
+        anchors = jnp.asarray(self.boxes, x.dtype)  # [A,2]
+        return (
+            jax.nn.sigmoid(xy),
+            jnp.exp(wh) * anchors,
+            jax.nn.sigmoid(conf),
+            jax.nn.softmax(cls, axis=-1),
+        )
+
+    # -- loss --------------------------------------------------------------
+    def score(self, params, x, labels, mask=None, average=True, weights=None):
+        """YOLOv2 composite loss (Yolo2OutputLayer.computeScore equivalent):
+        coord (xy + sqrt-wh) on responsible anchors, objectness MSE toward
+        IOU (positives) / 0 (negatives), class cross-entropy on object cells."""
+        n_classes = labels.shape[-1] - 4
+        B, H, W, _ = labels.shape
+        A = self.n_anchors
+
+        gt_box = labels[..., :4]                    # [B,H,W,4] grid units
+        gt_cls = labels[..., 4:]                    # [B,H,W,C]
+        obj = (jnp.sum(gt_cls, axis=-1) > 0).astype(x.dtype)  # [B,H,W]
+
+        pxy, pwh, pconf, pcls = self.activate(x, n_classes)
+
+        # ground-truth center/size in grid units, offsets within the cell
+        gt_cxy = (gt_box[..., 0:2] + gt_box[..., 2:4]) / 2.0
+        gt_wh = jnp.maximum(gt_box[..., 2:4] - gt_box[..., 0:2], 1e-6)
+        gt_off = gt_cxy - jnp.floor(gt_cxy)
+
+        # IOU of each anchor's predicted box vs gt (shape [B,H,W,A])
+        inter = jnp.minimum(pwh[..., 0], gt_wh[..., None, 0]) * jnp.minimum(
+            pwh[..., 1], gt_wh[..., None, 1]
+        )
+        union = pwh[..., 0] * pwh[..., 1] + (gt_wh[..., 0] * gt_wh[..., 1])[..., None] - inter
+        iou = inter / jnp.maximum(union, 1e-9)
+
+        # responsible anchor = highest-IOU anchor per object cell
+        resp = jax.nn.one_hot(jnp.argmax(iou, axis=-1), A, dtype=x.dtype)  # [B,H,W,A]
+        resp = resp * obj[..., None]
+
+        coord = jnp.sum(
+            resp
+            * (
+                jnp.sum((pxy - gt_off[..., None, :]) ** 2, axis=-1)
+                + jnp.sum((jnp.sqrt(pwh) - jnp.sqrt(gt_wh)[..., None, :]) ** 2, axis=-1)
+            )
+        )
+        conf_pos = jnp.sum(resp * (pconf - iou) ** 2)
+        conf_neg = jnp.sum((1.0 - resp) * pconf**2)
+        cls_loss = -jnp.sum(
+            obj[..., None] * gt_cls * jnp.log(jnp.maximum(
+                jnp.sum(resp[..., None] * pcls, axis=3), 1e-9))
+        )
+        total = (self.lambda_coord * coord + conf_pos
+                 + self.lambda_no_obj * conf_neg + cls_loss)
+        if average:
+            return total / B
+        return total
+
+
+def iou_xyxy(a: np.ndarray, b: np.ndarray) -> float:
+    """IOU of two (x1,y1,x2,y2) boxes (YoloUtils.iou)."""
+    ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+    iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+    inter = ix * iy
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+class DetectedObject:
+    """One decoded detection (nn/layers/objdetect/DetectedObject.java)."""
+
+    def __init__(self, box, confidence, class_idx, class_probs):
+        self.box = box  # (x1,y1,x2,y2) in grid units
+        self.confidence = float(confidence)
+        self.class_idx = int(class_idx)
+        self.class_probs = class_probs
+
+    def __repr__(self):
+        return f"DetectedObject(cls={self.class_idx}, conf={self.confidence:.3f}, box={self.box})"
+
+
+def get_predicted_objects(layer: Yolo2OutputLayer, grid_out, n_classes: int,
+                          threshold: float = 0.5) -> List[List[DetectedObject]]:
+    """Decode network output into per-image detections above ``threshold``
+    (YoloUtils.getPredictedObjects)."""
+    pxy, pwh, pconf, pcls = (np.asarray(t) for t in layer.activate(jnp.asarray(grid_out), n_classes))
+    B, H, W, A = pconf.shape
+    out: List[List[DetectedObject]] = []
+    for b in range(B):
+        dets: List[DetectedObject] = []
+        for i in range(H):
+            for j in range(W):
+                for a in range(A):
+                    conf = pconf[b, i, j, a]
+                    if conf < threshold:
+                        continue
+                    cx = j + pxy[b, i, j, a, 0]
+                    cy = i + pxy[b, i, j, a, 1]
+                    w, h = pwh[b, i, j, a]
+                    box = (cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2)
+                    probs = pcls[b, i, j, a]
+                    dets.append(DetectedObject(box, conf, int(np.argmax(probs)), probs))
+        out.append(dets)
+    return out
+
+
+def non_max_suppression(dets: List[DetectedObject], iou_threshold: float = 0.45
+                        ) -> List[DetectedObject]:
+    """Greedy class-wise NMS (YoloUtils.nms)."""
+    keep: List[DetectedObject] = []
+    for cls in {d.class_idx for d in dets}:
+        cand = sorted((d for d in dets if d.class_idx == cls),
+                      key=lambda d: -d.confidence)
+        while cand:
+            best = cand.pop(0)
+            keep.append(best)
+            cand = [d for d in cand
+                    if iou_xyxy(np.asarray(best.box), np.asarray(d.box)) < iou_threshold]
+    return keep

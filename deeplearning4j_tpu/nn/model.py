@@ -118,9 +118,11 @@ def _cast_input(x, dtype):
 def _as_batch(batch):
     """Normalize a batch to (features, labels, features_mask, labels_mask).
 
-    Accepts (x, y), (x, y, fmask), (x, y, fmask, lmask) tuples or a dict with
-    those keys — the DataSet / MultiDataSet surface of the reference.
+    Accepts (x, y), (x, y, fmask), (x, y, fmask, lmask) tuples, a dict with
+    those keys, or a DataSet object — the DataSet surface of the reference.
     """
+    if hasattr(batch, "as_tuple"):  # datasets.DataSet / MultiDataSet
+        batch = batch.as_tuple()
     if isinstance(batch, dict):
         return (
             batch["features"],
@@ -138,8 +140,10 @@ def _as_batch(batch):
 
 
 def _iter_batches(data, batch_size=None):
-    """Yield batches from (x, y[, masks]) arrays (optionally minibatched) or
-    any iterable of batches."""
+    """Yield batches from (x, y[, masks]) arrays (optionally minibatched), a
+    DataSet object, or any iterable of batches."""
+    if hasattr(data, "as_tuple"):  # datasets.DataSet: unpack, then minibatch
+        data = data.as_tuple()
     if isinstance(data, (tuple, list)) and len(data) >= 2 and not isinstance(data[0], (tuple, list, dict)):
         x, y, fm, lm = _as_batch(data)
         n = len(x)

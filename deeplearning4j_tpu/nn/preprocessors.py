@@ -178,6 +178,13 @@ def infer_preprocessor(from_type: InputType, to_layer) -> Optional[LayerConfig]:
 
     if isinstance(to_layer, shape_preserving):
         return None
+    if getattr(to_layer, "CONSUMES_CONV", False) and from_type.kind in ("conv", "conv_flat"):
+        # layers that natively take [b,h,w,c] without being "conv layers"
+        # (Cropping2D, Yolo2OutputLayer, CnnLossLayer)
+        if from_type.kind == "conv_flat":
+            return FeedForwardToCnn(height=from_type.height, width=from_type.width,
+                                    channels=from_type.channels)
+        return None
     if isinstance(to_layer, conv_layers) and from_type.kind == "conv_flat":
         return FeedForwardToCnn(height=from_type.height, width=from_type.width, channels=from_type.channels)
     if isinstance(to_layer, conv_layers) and from_type.kind == "ff":

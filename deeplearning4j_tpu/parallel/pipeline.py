@@ -90,6 +90,11 @@ class PipelineParallel:
         pipe_axis: str = "pipe",
         learning_rate: float = 1e-2,
     ):
+        if n_stages != mesh.shape[pipe_axis]:
+            raise ValueError(
+                f"n_stages={n_stages} must equal the mesh's '{pipe_axis}' axis "
+                f"size ({mesh.shape[pipe_axis]}): one stage per pipe rank"
+            )
         self.stage_apply = stage_apply
         self.n_stages = n_stages
         self.mesh = mesh

@@ -1,0 +1,84 @@
+"""Layerwise unsupervised pretraining (VAE ELBO, denoising autoencoder).
+
+Capability parity with the reference's pretrain path
+(MultiLayerNetwork.pretrain / pretrainLayer — the Solver drives a
+pretrainable layer's own score; gradientcheck/GradientCheckUtil.java:512
+checks it). TPU-first: each layer's pretrain objective is one jitted step
+over (that layer's params) with earlier layers applied inference-mode as a
+fixed featurizer.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.model import MultiLayerNetwork, _iter_batches
+from deeplearning4j_tpu.train.updaters import make_updater, normalize_updater
+
+
+def _pretrain_loss(layer, params, x, rng):
+    """Dispatch to the layer's unsupervised objective."""
+    if hasattr(layer, "elbo_loss"):  # VariationalAutoencoder
+        return layer.elbo_loss(params, x, rng)
+    if hasattr(layer, "reconstruct"):  # AutoEncoder: denoising MSE
+        rec = layer.reconstruct(params, x, rng=rng, corrupt=True)
+        return jnp.mean(jnp.sum((rec - x) ** 2, axis=-1))
+    raise ValueError(f"Layer {layer._type_name} is not pretrainable")
+
+
+def is_pretrainable(layer) -> bool:
+    return hasattr(layer, "elbo_loss") or hasattr(layer, "reconstruct")
+
+
+def pretrain_layer(model: MultiLayerNetwork, layer_idx: int, data,
+                   epochs: int = 1, batch_size: Optional[int] = None,
+                   updater=None) -> MultiLayerNetwork:
+    """Unsupervised-train ONE layer; earlier layers featurize inference-mode
+    (MultiLayerNetwork.pretrainLayer equivalent)."""
+    layer = model.layers[layer_idx]
+    if not is_pretrainable(layer):
+        raise ValueError(f"layer {layer_idx} ({layer._type_name}) is not pretrainable")
+    upd = make_updater(normalize_updater(updater or model.conf.updater))
+    opt_state = upd.init(model.params[layer_idx])
+
+    def step(lparams, opt_state, it, rng, x):
+        def loss_fn(p):
+            return _pretrain_loss(layer, p, x, rng)
+
+        loss, grads = jax.value_and_grad(loss_fn)(lparams)
+        delta, new_opt = upd.update(grads, opt_state, lparams, it)
+        new_params = jax.tree_util.tree_map(lambda p, d: p - d, lparams, delta)
+        return new_params, new_opt, loss
+
+    jstep = jax.jit(step, donate_argnums=(0, 1))
+    lparams = model.params[layer_idx]
+    it = 0
+    for _ in range(epochs):
+        source = data() if callable(data) else data
+        for x, _, _, _ in _iter_batches(source, batch_size):
+            # featurize through the preceding stack, no state updates
+            feats, _, _, _, _ = model._forward(
+                model.params, model.state, x, train=False, rngs=None, upto=layer_idx
+            )
+            lparams, opt_state, loss = jstep(
+                lparams, opt_state, jnp.asarray(it, jnp.int32), model._next_rng(), feats
+            )
+            it += 1
+    model.params = model.params[:layer_idx] + (lparams,) + model.params[layer_idx + 1:]
+    return model
+
+
+def pretrain(model: MultiLayerNetwork, data, epochs: int = 1,
+             batch_size: Optional[int] = None, updater=None) -> MultiLayerNetwork:
+    """Greedy layerwise pretraining over every pretrainable layer, in order
+    (MultiLayerNetwork.pretrain equivalent)."""
+    if model.params is None:
+        model.init()
+    for i, layer in enumerate(model.layers):
+        if is_pretrainable(layer):
+            pretrain_layer(model, i, data, epochs=epochs, batch_size=batch_size,
+                           updater=updater)
+    return model

@@ -1,0 +1,213 @@
+"""Datasets subsystem tests (SURVEY.md §2.2 + DataVec capability §2.4)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import (
+    AsyncDataSetIterator,
+    BenchmarkDataSetIterator,
+    CSVRecordReader,
+    DataSet,
+    DataSetIteratorSplitter,
+    EarlyTerminationDataSetIterator,
+    FileDataSetIterator,
+    ImagePreProcessingScaler,
+    IrisDataSetIterator,
+    ListDataSetIterator,
+    MnistDataSetIterator,
+    MultiDataSet,
+    MultipleEpochsIterator,
+    Normalizer,
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+    RecordReaderDataSetIterator,
+    SequenceRecordReaderDataSetIterator,
+    UciSequenceDataSetIterator,
+    uci_synthetic_control,
+)
+
+
+def _toy(n=20, f=4, c=3, seed=0):
+    rs = np.random.RandomState(seed)
+    return DataSet(rs.randn(n, f).astype(np.float32),
+                   np.eye(c, dtype=np.float32)[rs.randint(0, c, n)])
+
+
+class TestDataSet:
+    def test_batching_shuffle_split_merge(self):
+        ds = _toy(20)
+        batches = ds.batch_by(6)
+        assert [len(b) for b in batches] == [6, 6, 6, 2]
+        tr, te = ds.split_test_and_train(15)
+        assert len(tr) == 15 and len(te) == 5
+        back = DataSet.merge([tr, te])
+        np.testing.assert_array_equal(back.features, ds.features)
+        sh = ds.shuffle(0)
+        assert sorted(sh.features[:, 0].tolist()) == sorted(ds.features[:, 0].tolist())
+
+    def test_save_load_roundtrip(self, tmp_path):
+        ds = _toy()
+        p = str(tmp_path / "d.npz")
+        ds.save(p)
+        back = DataSet.load(p)
+        np.testing.assert_array_equal(back.features, ds.features)
+        np.testing.assert_array_equal(back.labels, ds.labels)
+
+    def test_multidataset_merge(self):
+        a = MultiDataSet((np.ones((2, 3)),), (np.zeros((2, 1)),))
+        b = MultiDataSet((np.ones((3, 3)),), (np.zeros((3, 1)),))
+        m = MultiDataSet.merge([a, b])
+        assert m.features[0].shape == (5, 3)
+
+    def test_fit_integration(self):
+        """model.fit consumes a DataSet directly (tuple protocol)."""
+        from deeplearning4j_tpu.nn.input_type import InputType
+        from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+        from deeplearning4j_tpu.nn.model import MultiLayerConfiguration, MultiLayerNetwork
+
+        ds = _toy(16)
+        conf = MultiLayerConfiguration(
+            layers=(Dense(n_out=8, activation="relu"),
+                    OutputLayer(n_out=3, activation="softmax")),
+            input_type=InputType.feed_forward(4), updater={"type": "sgd", "lr": 0.1})
+        m = MultiLayerNetwork(conf).init()
+        s0 = m.score(ds.as_tuple())
+        m.fit(ListDataSetIterator(ds, 8), epochs=5)
+        assert m.score(ds.as_tuple()) < s0
+
+
+class TestIterators:
+    def test_list_iterator(self):
+        it = ListDataSetIterator(_toy(20), 8)
+        assert [len(b) for b in it] == [8, 8, 4]
+        assert [len(b) for b in it] == [8, 8, 4]  # re-iterable
+
+    def test_async_prefetch_order_preserved(self):
+        base = ListDataSetIterator(_toy(40), 8)
+        sync = [b.features[0, 0] for b in base]
+        asyn = [b.features[0, 0] for b in AsyncDataSetIterator(base, queue_size=2)]
+        assert sync == asyn
+
+    def test_async_propagates_errors(self):
+        def bad():
+            yield _toy(4)
+            raise RuntimeError("producer failed")
+
+        with pytest.raises(RuntimeError, match="producer failed"):
+            list(AsyncDataSetIterator(bad()))
+
+    def test_early_termination(self):
+        it = EarlyTerminationDataSetIterator(ListDataSetIterator(_toy(80), 8), 3)
+        assert len(list(it)) == 3
+
+    def test_multiple_epochs(self):
+        it = MultipleEpochsIterator(ListDataSetIterator(_toy(16), 8), 3)
+        assert len(list(it)) == 6
+
+    def test_splitter(self):
+        sp = DataSetIteratorSplitter(ListDataSetIterator(_toy(80), 8), 10, 0.7)
+        assert len(list(sp.train)) == 7
+        assert len(list(sp.test)) == 3
+
+    def test_benchmark_iterator(self):
+        it = BenchmarkDataSetIterator((16, 8), 4, 5)
+        bs = list(it)
+        assert len(bs) == 5
+        assert bs[0].features.shape == (16, 8)
+
+    def test_file_iterator(self, tmp_path):
+        for i in range(3):
+            _toy(8, seed=i).save(str(tmp_path / f"b{i}.npz"))
+        it = FileDataSetIterator(str(tmp_path))
+        assert len(list(it)) == 3
+
+
+class TestNormalizers:
+    def test_standardize_roundtrip(self):
+        ds = _toy(200)
+        n = NormalizerStandardize().fit(ds)
+        out = n.transform(ds)
+        np.testing.assert_allclose(out.features.mean(0), 0, atol=1e-5)
+        np.testing.assert_allclose(out.features.std(0), 1, atol=1e-4)
+        back = n.revert_features(out.features)
+        np.testing.assert_allclose(back, ds.features, atol=1e-5)
+        n2 = Normalizer.from_json(n.to_json())
+        np.testing.assert_allclose(n2.transform(ds).features, out.features, atol=1e-6)
+
+    def test_minmax(self):
+        ds = _toy(50)
+        n = NormalizerMinMaxScaler(0.0, 1.0).fit(ds)
+        out = n.transform(ds)
+        assert out.features.min() >= -1e-6 and out.features.max() <= 1 + 1e-6
+
+    def test_image_scaler(self):
+        x = np.full((2, 4, 4, 1), 255.0, np.float32)
+        out = ImagePreProcessingScaler().transform_features(x)
+        np.testing.assert_allclose(out, 1.0)
+
+    def test_iterator_preprocessor_hook(self):
+        ds = _toy(20)
+        n = NormalizerStandardize().fit(ds)
+        it = ListDataSetIterator(ds, 10).set_pre_processor(n)
+        b = next(iter(it))
+        assert abs(b.features.mean()) < 1.0
+
+
+class TestBuiltins:
+    def test_iris_real_data(self):
+        it = IrisDataSetIterator(50, 150)
+        batches = list(it)
+        assert len(batches) == 3
+        assert batches[0].features.shape == (50, 4)
+        assert batches[0].labels.shape == (50, 3)
+
+    def test_mnist_shapes(self):
+        it = MnistDataSetIterator(32, train=False, seed=1)
+        b = next(iter(it))
+        assert b.features.shape == (32, 28, 28, 1)
+        assert b.labels.shape == (32, 10)
+        assert 0.0 <= b.features.min() and b.features.max() <= 1.0
+
+    def test_uci_generator_classes_separable(self):
+        x, y = uci_synthetic_control(n_per_class=10)
+        assert x.shape == (60, 60, 1) and y.shape == (60, 6)
+        # increasing trend class must end higher than it starts
+        inc = x[y.argmax(1) == 2]
+        assert (inc[:, -5:].mean(axis=(1, 2)) > inc[:, :5].mean(axis=(1, 2))).all()
+
+    def test_uci_iterator(self):
+        it = UciSequenceDataSetIterator(16, train=True)
+        b = next(iter(it))
+        assert b.features.shape[1:] == (60, 1)
+        assert b.labels.shape[1:] == (60, 6)
+
+
+class TestRecordReaders:
+    def test_csv_reader_and_iterator(self, tmp_path):
+        p = tmp_path / "data.csv"
+        rows = ["1.0,2.0,0", "3.0,4.0,1", "5.0,6.0,2", "7.0,8.0,0"]
+        p.write_text("\n".join(rows))
+        it = RecordReaderDataSetIterator(str(p), 2, label_index=2, num_classes=3)
+        bs = list(it)
+        assert len(bs) == 2
+        assert bs[0].features.shape == (2, 2)
+        np.testing.assert_array_equal(bs[0].labels[0], [1, 0, 0])
+
+    def test_sequence_reader_padding_mask(self, tmp_path):
+        f1 = tmp_path / "f1.csv"; f1.write_text("1,2\n3,4\n5,6")
+        f2 = tmp_path / "f2.csv"; f2.write_text("7,8")
+        l1 = tmp_path / "l1.csv"; l1.write_text("0\n1\n0")
+        l2 = tmp_path / "l2.csv"; l2.write_text("1")
+        it = SequenceRecordReaderDataSetIterator(
+            [str(f1), str(f2)], [str(l1), str(l2)], 2, num_classes=2)
+        b = next(iter(it))
+        assert b.features.shape == (2, 3, 2)
+        np.testing.assert_array_equal(b.features_mask, [[1, 1, 1], [1, 0, 0]])
+
+    def test_csv_skip_lines(self, tmp_path):
+        p = tmp_path / "h.csv"
+        p.write_text("a,b\n1,2\n3,4")
+        arr = CSVRecordReader(skip_lines=1).read(str(p))
+        assert arr.shape == (2, 2)

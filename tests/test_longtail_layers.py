@@ -1,0 +1,257 @@
+"""VAE, YOLO2, CenterLoss, CnnLoss, custom-layer API, pretraining tests
+(SURVEY.md §2.1 rows: layer configs / layer implementations long tail)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.config import LayerConfig
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers import (
+    CenterLossOutputLayer,
+    CnnLossLayer,
+    Conv2D,
+    CustomLayer,
+    Dense,
+    FrozenLayer,
+    LambdaLayer,
+    OutputLayer,
+    VariationalAutoencoder,
+    Yolo2OutputLayer,
+    get_predicted_objects,
+    non_max_suppression,
+)
+from deeplearning4j_tpu.nn.layers.objdetect import DetectedObject, iou_xyxy
+from deeplearning4j_tpu.nn.model import MultiLayerConfiguration, MultiLayerNetwork
+from deeplearning4j_tpu.train.pretrain import pretrain, pretrain_layer
+
+
+class TestVAE:
+    def _vae(self, rec="bernoulli"):
+        return VariationalAutoencoder(
+            n_in=12, n_out=3, encoder_layer_sizes=(16,), decoder_layer_sizes=(16,),
+            reconstruction=rec, activation="tanh")
+
+    def test_forward_is_posterior_mean(self):
+        v = self._vae()
+        p = v.init(jax.random.PRNGKey(0), InputType.feed_forward(12))
+        x = jax.random.uniform(jax.random.PRNGKey(1), (4, 12))
+        y, _ = v.apply(p, {}, x)
+        assert y.shape == (4, 3)
+
+    @pytest.mark.parametrize("rec", ["bernoulli", "gaussian"])
+    def test_elbo_decreases_under_pretraining(self, rec):
+        v = self._vae(rec)
+        conf = MultiLayerConfiguration(
+            layers=(v, OutputLayer(n_out=2, activation="softmax")),
+            input_type=InputType.feed_forward(12),
+            updater={"type": "adam", "lr": 1e-2})
+        m = MultiLayerNetwork(conf).init()
+        rs = np.random.RandomState(0)
+        x = (rs.rand(64, 12) > 0.5).astype(np.float32) if rec == "bernoulli" else \
+            rs.randn(64, 12).astype(np.float32)
+        l0 = float(v.elbo_loss(m.params[0], jnp.asarray(x), jax.random.PRNGKey(2)))
+        pretrain_layer(m, 0, (x, None), epochs=30)
+        l1 = float(v.elbo_loss(m.params[0], jnp.asarray(x), jax.random.PRNGKey(2)))
+        assert l1 < l0
+
+    def test_reconstruction_log_prob_and_generate(self):
+        v = self._vae()
+        p = v.init(jax.random.PRNGKey(0), InputType.feed_forward(12))
+        x = (jax.random.uniform(jax.random.PRNGKey(1), (4, 12)) > 0.5).astype(jnp.float32)
+        lp = v.reconstruction_log_probability(p, x, jax.random.PRNGKey(2), num_samples=3)
+        assert lp.shape == (4,)
+        assert bool(jnp.isfinite(lp).all())
+        z = jax.random.normal(jax.random.PRNGKey(3), (5, 3))
+        g = v.generate(p, z)
+        assert g.shape == (5, 12)
+        assert float(g.min()) >= 0.0 and float(g.max()) <= 1.0  # bernoulli means
+
+    def test_greedy_pretrain_walks_all_pretrainable(self):
+        from deeplearning4j_tpu.nn.layers import AutoEncoder
+
+        conf = MultiLayerConfiguration(
+            layers=(AutoEncoder(n_out=8), self._vae()._replace_n_in(8) if False else
+                    VariationalAutoencoder(n_out=3, encoder_layer_sizes=(8,),
+                                           decoder_layer_sizes=(8,), activation="tanh"),
+                    OutputLayer(n_out=2, activation="softmax")),
+            input_type=InputType.feed_forward(12),
+            updater={"type": "adam", "lr": 1e-2})
+        m = MultiLayerNetwork(conf).init()
+        rs = np.random.RandomState(0)
+        x = rs.rand(32, 12).astype(np.float32)
+        pretrain(m, (x, None), epochs=2)  # runs without error, both layers
+
+
+class TestYolo2:
+    def _layer(self):
+        return Yolo2OutputLayer(boxes=((1.0, 1.0), (2.0, 2.0)))
+
+    def _labels(self, B=2, H=4, W=4, C=3):
+        y = np.zeros((B, H, W, 4 + C), np.float32)
+        # one object in cell (1,2) of each image: box in grid units
+        y[:, 1, 2, :4] = [2.1, 1.2, 2.9, 1.8]
+        y[:, 1, 2, 4] = 1.0  # class 0
+        return y
+
+    def test_loss_finite_and_trains(self):
+        layer = self._layer()
+        C, A = 3, 2
+        conf = MultiLayerConfiguration(
+            layers=(Conv2D(n_out=A * (5 + C), kernel=(1, 1), activation="identity",
+                           convolution_mode="same"),
+                    layer),
+            input_type=InputType.convolutional(4, 4, 8),
+            updater={"type": "adam", "lr": 1e-3})
+        m = MultiLayerNetwork(conf).init()
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 4, 4, 8).astype(np.float32)
+        y = self._labels()
+        s0 = m.score(x, y)
+        assert np.isfinite(s0)
+        m.fit((x, y), epochs=20)
+        assert m.score(x, y) < s0
+
+    def test_decode_and_nms(self):
+        layer = self._layer()
+        C = 3
+        rs = np.random.RandomState(0)
+        grid = rs.randn(1, 4, 4, 2 * (5 + C)).astype(np.float32)
+        dets = get_predicted_objects(layer, grid, C, threshold=0.0)
+        assert len(dets) == 1 and len(dets[0]) == 32  # every anchor decoded
+        kept = non_max_suppression(dets[0], iou_threshold=0.5)
+        assert 0 < len(kept) <= len(dets[0])
+
+    def test_iou(self):
+        assert iou_xyxy(np.array([0, 0, 2, 2]), np.array([0, 0, 2, 2])) == 1.0
+        assert iou_xyxy(np.array([0, 0, 1, 1]), np.array([2, 2, 3, 3])) == 0.0
+
+
+class TestCenterLoss:
+    def test_trains_and_centers_move(self):
+        conf = MultiLayerConfiguration(
+            layers=(Dense(n_out=8, activation="relu"),
+                    CenterLossOutputLayer(n_out=3, lambda_=0.01)),
+            input_type=InputType.feed_forward(4),
+            updater={"type": "adam", "lr": 1e-2})
+        m = MultiLayerNetwork(conf).init()
+        rs = np.random.RandomState(0)
+        x = rs.randn(32, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 32)]
+        c0 = np.asarray(m.params[-1]["centers"]).copy()
+        s0 = m.score(x, y)
+        m.fit((x, y), epochs=20)
+        assert m.score(x, y) < s0
+        assert not np.allclose(np.asarray(m.params[-1]["centers"]), c0)
+
+
+class TestCnnLoss:
+    def test_per_pixel_loss(self):
+        conf = MultiLayerConfiguration(
+            layers=(Conv2D(n_out=3, kernel=(3, 3), activation="identity",
+                           convolution_mode="same"),
+                    CnnLossLayer(activation="softmax", loss="mcxent")),
+            input_type=InputType.convolutional(6, 6, 2),
+            updater={"type": "adam", "lr": 1e-2})
+        m = MultiLayerNetwork(conf).init()
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 6, 6, 2).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, (2, 6, 6))]
+        s0 = m.score(x, y)
+        m.fit((x, y), epochs=15)
+        assert m.score(x, y) < s0
+
+
+class TestCustomLayerAPI:
+    def test_lambda_layer(self):
+        conf = MultiLayerConfiguration(
+            layers=(LambdaLayer(fn=lambda x: x * 2.0),
+                    OutputLayer(n_out=2, activation="softmax")),
+            input_type=InputType.feed_forward(3),
+            updater={"type": "sgd", "lr": 0.1})
+        m = MultiLayerNetwork(conf).init()
+        out = m.output(np.ones((1, 3), np.float32))
+        assert out.shape == (1, 2)
+
+    def test_custom_layer_subclass(self):
+        from deeplearning4j_tpu.nn.config import register_layer
+        from dataclasses import dataclass
+
+        @register_layer("test_scaledense")
+        @dataclass
+        class ScaleDense(CustomLayer):
+            n_out: int = 4
+
+            def output_type(self, input_type):
+                return InputType.feed_forward(self.n_out)
+
+            def init(self, key, input_type, dtype=jnp.float32):
+                return {"W": jax.random.normal(key, (input_type.flat_size(), self.n_out), dtype) * 0.1}
+
+            def forward(self, params, x):
+                return jnp.tanh(x @ params["W"])
+
+        cfg = ScaleDense(n_out=4)
+        back = LayerConfig.from_json(cfg.to_json())
+        assert back == cfg
+        conf = MultiLayerConfiguration(
+            layers=(cfg, OutputLayer(n_out=2, activation="softmax")),
+            input_type=InputType.feed_forward(3),
+            updater={"type": "sgd", "lr": 0.1})
+        m = MultiLayerNetwork(conf).init()
+        rs = np.random.RandomState(0)
+        x = rs.randn(8, 3).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 8)]
+        s0 = m.score(x, y)
+        m.fit((x, y), epochs=10)
+        assert m.score(x, y) < s0
+
+    def test_frozen_layer_params_dont_move(self):
+        inner = Dense(n_out=4, activation="relu")
+        conf = MultiLayerConfiguration(
+            layers=(FrozenLayer(inner=inner),
+                    OutputLayer(n_out=2, activation="softmax")),
+            input_type=InputType.feed_forward(3),
+            updater={"type": "sgd", "lr": 0.5})
+        m = MultiLayerNetwork(conf).init()
+        w0 = np.asarray(m.params[0]["W"]).copy()
+        rs = np.random.RandomState(0)
+        x = rs.randn(8, 3).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 8)]
+        m.fit((x, y), epochs=5)
+        np.testing.assert_array_equal(np.asarray(m.params[0]["W"]), w0)
+
+    def test_frozen_serde(self):
+        cfg = FrozenLayer(inner=Dense(n_out=4, activation="relu"))
+        back = LayerConfig.from_json(cfg.to_json())
+        assert back.inner == cfg.inner
+
+
+class TestGradientChecksNewHeads:
+    def test_centerloss_gradcheck(self):
+        from deeplearning4j_tpu.utils.gradientcheck import check_gradients
+
+        conf = MultiLayerConfiguration(
+            layers=(Dense(n_out=6, activation="tanh"),
+                    CenterLossOutputLayer(n_out=3, lambda_=0.01)),
+            input_type=InputType.feed_forward(4), dtype="float64")
+        m = MultiLayerNetwork(conf).init()
+        rs = np.random.RandomState(0)
+        x = rs.randn(6, 4)
+        y = np.eye(3)[rs.randint(0, 3, 6)]
+        assert check_gradients(m, x, y, subset=20)
+
+    def test_cnnloss_gradcheck(self):
+        from deeplearning4j_tpu.utils.gradientcheck import check_gradients
+
+        conf = MultiLayerConfiguration(
+            layers=(Conv2D(n_out=3, kernel=(3, 3), activation="tanh",
+                           convolution_mode="same"),
+                    CnnLossLayer(activation="softmax", loss="mcxent")),
+            input_type=InputType.convolutional(5, 5, 2), dtype="float64")
+        m = MultiLayerNetwork(conf).init()
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 5, 5, 2)
+        y = np.eye(3)[rs.randint(0, 3, (2, 5, 5))]
+        assert check_gradients(m, x, y, subset=20)

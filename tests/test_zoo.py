@@ -1,0 +1,106 @@
+"""Zoo model tests: every reference architecture builds, JSON round-trips,
+and runs a forward pass at reduced input size (SURVEY.md §2.8 zoo row)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import (
+    AlexNet,
+    Darknet19,
+    FaceNetNN4Small2,
+    GoogLeNet,
+    InceptionResNetV1,
+    LeNet5,
+    ResNet50,
+    SimpleCNN,
+    TinyYOLO,
+    TransformerLM,
+    VGG16,
+    VGG19,
+)
+from deeplearning4j_tpu.nn.graph import ComputationGraph, ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.model import MultiLayerConfiguration, MultiLayerNetwork
+
+
+def _build(conf):
+    if isinstance(conf, ComputationGraphConfiguration):
+        return ComputationGraph(conf).init()
+    return MultiLayerNetwork(conf).init()
+
+
+def _roundtrip(conf):
+    if isinstance(conf, ComputationGraphConfiguration):
+        return ComputationGraphConfiguration.from_json(conf.to_json())
+    return MultiLayerConfiguration.from_json(conf.to_json())
+
+
+SMALL_SEQUENTIAL = [
+    ("alexnet", lambda: AlexNet(height=63, width=63, num_classes=5)),
+    ("vgg16", lambda: VGG16(height=32, width=32, num_classes=5)),
+    ("vgg19", lambda: VGG19(height=32, width=32, num_classes=5)),
+    ("darknet19", lambda: Darknet19(height=32, width=32, num_classes=5)),
+]
+
+SMALL_GRAPH = [
+    ("resnet50", lambda: ResNet50(height=32, width=32, num_classes=5)),
+    ("googlenet", lambda: GoogLeNet(height=64, width=64, num_classes=5)),
+    ("inception_resnet_v1", lambda: InceptionResNetV1(
+        height=64, width=64, num_classes=5, n_blocks=(1, 1, 1))),
+    ("facenet", lambda: FaceNetNN4Small2(height=64, width=64, num_classes=5)),
+]
+
+
+class TestSequentialZoo:
+    @pytest.mark.parametrize("name,make", SMALL_SEQUENTIAL, ids=[n for n, _ in SMALL_SEQUENTIAL])
+    def test_build_forward_roundtrip(self, name, make):
+        conf = make()
+        assert _roundtrip(conf).to_json() == conf.to_json()
+        m = _build(conf)
+        h = conf.input_type.height
+        w = conf.input_type.width
+        x = np.random.RandomState(0).randn(2, h, w, 3).astype(np.float32)
+        out = m.output(x)
+        assert out.shape == (2, 5)
+        s = np.asarray(out).sum(axis=-1)
+        np.testing.assert_allclose(s, 1.0, atol=1e-3)  # softmax head
+
+
+class TestGraphZoo:
+    @pytest.mark.parametrize("name,make", SMALL_GRAPH, ids=[n for n, _ in SMALL_GRAPH])
+    def test_build_forward_roundtrip(self, name, make):
+        conf = make()
+        assert _roundtrip(conf).to_json() == conf.to_json()
+        m = _build(conf)
+        it = list(conf.input_types.values())[0] if isinstance(conf.input_types, dict) else conf.input_types[0]
+        x = np.random.RandomState(0).randn(2, it.height, it.width, 3).astype(np.float32)
+        out = m.output(x)
+        assert out.shape == (2, 5)
+
+
+class TestTinyYOLO:
+    def test_grid_shape_and_loss(self):
+        conf = TinyYOLO(height=64, width=64, num_classes=3)
+        m = _build(conf)
+        x = np.random.RandomState(0).randn(1, 64, 64, 3).astype(np.float32)
+        out = m.output(x)
+        # 64 / 2^5 = 2x2 grid, 5 anchors * (5+3) = 40 channels
+        assert out.shape == (1, 2, 2, 40)
+        y = np.zeros((1, 2, 2, 7), np.float32)
+        y[:, 0, 0, :4] = [0.1, 0.1, 0.9, 0.9]
+        y[:, 0, 0, 4] = 1.0
+        assert np.isfinite(m.score(x, y))
+
+
+class TestResNet50Trains:
+    def test_one_step_reduces_loss(self):
+        conf = ResNet50(height=32, width=32, num_classes=4,
+                        updater={"type": "adam", "lr": 1e-3})
+        m = _build(conf)
+        rs = np.random.RandomState(0)
+        x = rs.randn(4, 32, 32, 3).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 4)]
+        s0 = m.score(((x,), (y,)))
+        for _ in range(6):
+            m.fit_batch(((x,), (y,), None, None))
+        s1 = m.score(((x,), (y,)))
+        assert s1 < s0
