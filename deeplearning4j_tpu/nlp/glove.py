@@ -1,0 +1,147 @@
+"""GloVe embeddings.
+
+Capability parity with the reference's GloVe learning impl
+(models/embeddings/learning/impl/elements/GloVe.java + models/glove/ —
+SURVEY.md §2.7). TPU-first: the co-occurrence matrix builds host-side (it
+is a string-processing pass, like the reference's co-occurrence pipeline);
+training runs as jitted AdaGrad steps over BATCHES of nonzero (i, j, X_ij)
+triples — gathers, the weighted-least-squares loss, and scatter updates in
+one XLA program per batch.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabConstructor
+
+
+def _glove_step(params, wi, wj, xij, lr, x_max, alpha):
+    """One AdaGrad batch: J = Σ f(X) (w_i·w̃_j + b_i + b̃_j - log X)²."""
+    W, Wc, b, bc = params["W"], params["Wc"], params["b"], params["bc"]
+    hW, hWc, hb, hbc = params["hW"], params["hWc"], params["hb"], params["hbc"]
+
+    vi = W[wi]
+    vj = Wc[wj]
+    diff = jnp.sum(vi * vj, axis=-1) + b[wi] + bc[wj] - jnp.log(xij)
+    f = jnp.minimum((xij / x_max) ** alpha, 1.0)
+    loss = 0.5 * jnp.mean(f * diff * diff)
+
+    g = f * diff                                  # [B]
+    gW = g[:, None] * vj
+    gWc = g[:, None] * vi
+
+    # AdaGrad accumulate + update (scatter)
+    hW = hW.at[wi].add(gW * gW)
+    hWc = hWc.at[wj].add(gWc * gWc)
+    hb = hb.at[wi].add(g * g)
+    hbc = hbc.at[wj].add(g * g)
+    W = W.at[wi].add(-lr * gW / jnp.sqrt(hW[wi] + 1e-8))
+    Wc = Wc.at[wj].add(-lr * gWc / jnp.sqrt(hWc[wj] + 1e-8))
+    b = b.at[wi].add(-lr * g / jnp.sqrt(hb[wi] + 1e-8))
+    bc = bc.at[wj].add(-lr * g / jnp.sqrt(hbc[wj] + 1e-8))
+    return {"W": W, "Wc": Wc, "b": b, "bc": bc,
+            "hW": hW, "hWc": hWc, "hb": hb, "hbc": hbc}, loss
+
+
+class Glove:
+    """models/glove/Glove.java surface: build co-occurrences, fit, lookup."""
+
+    def __init__(self, layer_size: int = 100, window: int = 5,
+                 learning_rate: float = 0.05, epochs: int = 5,
+                 min_word_frequency: int = 1, x_max: float = 100.0,
+                 alpha: float = 0.75, batch_size: int = 1024, seed: int = 12345,
+                 symmetric: bool = True, tokenizer_factory=None):
+        self.layer_size = layer_size
+        self.window = window
+        self.lr = learning_rate
+        self.epochs = epochs
+        self.min_word_frequency = min_word_frequency
+        self.x_max = x_max
+        self.alpha = alpha
+        self.batch_size = batch_size
+        self.seed = seed
+        self.symmetric = symmetric
+        self.tokenizer_factory = tokenizer_factory
+        self.vocab: Optional[VocabCache] = None
+        self.params: Optional[dict] = None
+
+    def _tokenize(self, sentences) -> List[List[str]]:
+        from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+
+        tok = self.tokenizer_factory or DefaultTokenizerFactory()
+        return [tok.tokenize(s) if isinstance(s, str) else list(s) for s in sentences]
+
+    def _cooccurrences(self, token_seqs) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        counts: Dict[Tuple[int, int], float] = defaultdict(float)
+        for toks in token_seqs:
+            idx = [self.vocab.index_of(t) for t in toks]
+            idx = [i for i in idx if i >= 0]
+            for i, wi in enumerate(idx):
+                for off in range(1, self.window + 1):
+                    j = i + off
+                    if j >= len(idx):
+                        break
+                    w = 1.0 / off  # distance weighting, as GloVe does
+                    counts[(wi, idx[j])] += w
+                    if self.symmetric:
+                        counts[(idx[j], wi)] += w
+        ii = np.asarray([k[0] for k in counts], np.int32)
+        jj = np.asarray([k[1] for k in counts], np.int32)
+        xx = np.asarray(list(counts.values()), np.float32)
+        return ii, jj, xx
+
+    def fit(self, sentences) -> "Glove":
+        token_seqs = self._tokenize(sentences() if callable(sentences) else sentences)
+        if self.vocab is None:
+            self.vocab = VocabConstructor(self.min_word_frequency).build(
+                [" ".join(t) for t in token_seqs]
+            )
+        V, D = len(self.vocab), self.layer_size
+        rs = np.random.RandomState(self.seed)
+        self.params = {
+            "W": jnp.asarray((rs.rand(V, D).astype(np.float32) - 0.5) / D),
+            "Wc": jnp.asarray((rs.rand(V, D).astype(np.float32) - 0.5) / D),
+            "b": jnp.zeros((V,), jnp.float32),
+            "bc": jnp.zeros((V,), jnp.float32),
+            "hW": jnp.full((V, D), 1e-8, jnp.float32),
+            "hWc": jnp.full((V, D), 1e-8, jnp.float32),
+            "hb": jnp.full((V,), 1e-8, jnp.float32),
+            "hbc": jnp.full((V,), 1e-8, jnp.float32),
+        }
+        ii, jj, xx = self._cooccurrences(token_seqs)
+        if len(ii) == 0:
+            return self
+        step = jax.jit(_glove_step, donate_argnums=(0,),
+                       static_argnames=("x_max", "alpha"))
+        for _ in range(self.epochs):
+            order = rs.permutation(len(ii))
+            for s in range(0, len(order), self.batch_size):
+                sel = order[s:s + self.batch_size]
+                self.params, _ = step(
+                    self.params, jnp.asarray(ii[sel]), jnp.asarray(jj[sel]),
+                    jnp.asarray(xx[sel]), jnp.asarray(self.lr, jnp.float32),
+                    x_max=self.x_max, alpha=self.alpha,
+                )
+        return self
+
+    # -- lookup ------------------------------------------------------------
+    @property
+    def syn0(self) -> np.ndarray:
+        return np.asarray(self.params["W"]) + np.asarray(self.params["Wc"])
+
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        i = self.vocab.index_of(word)
+        return self.syn0[i] if i >= 0 else None
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        if va is None or vb is None:
+            return float("nan")
+        denom = np.linalg.norm(va) * np.linalg.norm(vb)
+        return float(va @ vb / denom) if denom > 0 else 0.0

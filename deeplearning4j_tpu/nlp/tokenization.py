@@ -1,0 +1,155 @@
+"""Tokenizers, token preprocessors, sentence/document iterators.
+
+Capability parity with the reference's text pipeline
+(deeplearning4j-nlp-parent/deeplearning4j-nlp/.../text/: tokenization/
+tokenizerfactory/DefaultTokenizerFactory, NGramTokenizerFactory,
+tokenization/tokenizer/preprocessor/CommonPreprocessor,
+sentenceiterator/{BasicLineIterator,CollectionSentenceIterator,
+FileSentenceIterator}, documentiterator/LabelAwareIterator — SURVEY.md §2.7
+'Text pipeline' row). Host-side text handling; the TPU sees only index
+arrays.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import string
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+
+class CommonPreprocessor:
+    """Lowercase + strip punctuation (preprocessor/CommonPreprocessor.java)."""
+
+    _PUNCT = re.compile(r"[\d\.:,\"'\(\)\[\]|/?!;]+")
+
+    def pre_process(self, token: str) -> str:
+        return self._PUNCT.sub("", token).lower()
+
+    __call__ = pre_process
+
+
+class LowCasePreprocessor:
+    def pre_process(self, token: str) -> str:
+        return token.lower()
+
+    __call__ = pre_process
+
+
+class DefaultTokenizer:
+    """Whitespace tokenizer with optional per-token preprocessor
+    (tokenizer/DefaultTokenizer.java)."""
+
+    def __init__(self, text: str, pre: Optional[Callable] = None):
+        self._tokens = [t for t in text.split() if t]
+        if pre is not None:
+            self._tokens = [p for p in (pre(t) for t in self._tokens) if p]
+
+    def get_tokens(self) -> List[str]:
+        return list(self._tokens)
+
+    def __iter__(self):
+        return iter(self._tokens)
+
+
+class DefaultTokenizerFactory:
+    """tokenizerfactory/DefaultTokenizerFactory.java."""
+
+    def __init__(self):
+        self._pre: Optional[Callable] = None
+
+    def set_token_pre_processor(self, pre: Callable):
+        self._pre = pre
+        return self
+
+    def create(self, text: str) -> DefaultTokenizer:
+        return DefaultTokenizer(text, self._pre)
+
+    def tokenize(self, text: str) -> List[str]:
+        return self.create(text).get_tokens()
+
+
+class NGramTokenizerFactory(DefaultTokenizerFactory):
+    """n-gram over the base tokens (NGramTokenizerFactory.java)."""
+
+    def __init__(self, min_n: int = 1, max_n: int = 2):
+        super().__init__()
+        self.min_n, self.max_n = min_n, max_n
+
+    def tokenize(self, text: str) -> List[str]:
+        base = super().tokenize(text)
+        out: List[str] = []
+        for n in range(self.min_n, self.max_n + 1):
+            for i in range(len(base) - n + 1):
+                out.append(" ".join(base[i:i + n]))
+        return out
+
+
+# -- sentence / document iterators ------------------------------------------
+
+class CollectionSentenceIterator:
+    """In-memory list of sentences (CollectionSentenceIterator.java)."""
+
+    def __init__(self, sentences: Sequence[str]):
+        self.sentences = list(sentences)
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self.sentences)
+
+    def reset(self):
+        pass
+
+
+class BasicLineIterator:
+    """One sentence per line from a file (BasicLineIterator.java)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def __iter__(self) -> Iterator[str]:
+        with open(self.path, encoding="utf-8", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield line
+
+    def reset(self):
+        pass
+
+
+class FileSentenceIterator:
+    """Every file under a directory, one sentence per line
+    (FileSentenceIterator.java)."""
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def __iter__(self) -> Iterator[str]:
+        for dirpath, _, files in sorted(os.walk(self.root)):
+            for fn in sorted(files):
+                yield from BasicLineIterator(os.path.join(dirpath, fn))
+
+    def reset(self):
+        pass
+
+
+class LabelledDocument:
+    """documentiterator/LabelledDocument.java."""
+
+    def __init__(self, content: str, labels: Sequence[str]):
+        self.content = content
+        self.labels = list(labels)
+
+
+class LabelAwareIterator:
+    """Documents with labels, for ParagraphVectors
+    (documentiterator/LabelAwareIterator.java). Wraps (text, label) pairs."""
+
+    def __init__(self, docs: Sequence[Tuple[str, str]]):
+        self.docs = [LabelledDocument(t, [l]) for t, l in docs]
+
+    def __iter__(self) -> Iterator[LabelledDocument]:
+        return iter(self.docs)
+
+    def reset(self):
+        pass
