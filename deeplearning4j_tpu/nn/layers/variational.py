@@ -14,6 +14,7 @@ into the standard jitted step as the layer's score.
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional, Sequence, Tuple
 
@@ -24,7 +25,10 @@ from deeplearning4j_tpu.nn import activations, initializers
 from deeplearning4j_tpu.nn.config import FeedForwardLayerConfig, register_layer
 from deeplearning4j_tpu.nn.input_type import InputType
 
-_HALF_LOG_2PI = 0.5 * jnp.log(2 * jnp.pi)
+# math.log, NOT jnp.log: module-level jnp ops initialize the default JAX
+# backend at import time, which breaks callers that need to configure the
+# platform (e.g. a CPU mesh) before first use.
+_HALF_LOG_2PI = 0.5 * math.log(2 * math.pi)
 
 
 @register_layer("vae")

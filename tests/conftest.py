@@ -10,28 +10,17 @@ would otherwise still be dialed during device discovery.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Single source of truth for platform forcing + axon-plugin unregistration:
+# the same helper the driver's dryrun uses (__graft_entry__._provision_cpu_mesh).
+from __graft_entry__ import _provision_cpu_mesh  # noqa: E402
+
+_provision_cpu_mesh(8)
 
 import jax  # noqa: E402  (import after env vars so they take effect)
-
-# The sitecustomize hook imports jax before this file runs, so the
-# JAX_PLATFORMS=axon env default is already captured in jax's config —
-# override it at the config level, then drop the axon plugin factory so
-# device discovery cannot dial the tunnel either.
-jax.config.update("jax_platforms", "cpu")
-try:
-    from jax._src import xla_bridge as _xb
-
-    _factories = getattr(_xb, "_backend_factories", None)
-    if isinstance(_factories, dict):
-        _factories.pop("axon", None)
-except Exception:  # pragma: no cover - defensive; tests still pass without it
-    pass
-
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
