@@ -232,4 +232,10 @@ def average_score(
     if mask is not None and labels.ndim == 3 and mask.ndim == 2:
         denom = jnp.maximum(jnp.sum(mask), 1.0)
         return jnp.sum(scores) / denom
+    if mask is not None:
+        # Per-example mask: average over UNMASKED examples only, so a
+        # zero-weighted (padded) row neither contributes loss nor inflates
+        # the denominator (exactness of ParallelWrapper uneven batches).
+        denom = jnp.maximum(jnp.sum(mask.reshape(scores.shape)), 1.0)
+        return jnp.sum(scores) / denom
     return jnp.mean(scores)

@@ -61,7 +61,12 @@ class ParallelWrapper:
             self.model.opt_state = jax.tree_util.tree_map(put, self.model.opt_state)
 
     def _pad_to_shardable(self, arrs):
-        """Tile members of a batch so the leading axis divides n_data."""
+        """Tile members of a batch so the leading axis divides n_data.
+
+        Padded rows repeat real examples (benign numerics for batch-coupled
+        ops) but MUST be zero-weighted in the loss by the caller — see
+        ``_padded_lmask`` — or they would silently double-weight samples in
+        the gradient."""
         n = next(len(a) for a in arrs if a is not None)
         if n % self.n_data == 0:
             return arrs, n
@@ -75,6 +80,32 @@ class ParallelWrapper:
             return np.concatenate([a, reps])
 
         return tuple(_pad(a) for a in arrs), n
+
+    def _padded_lmask(self, y, lm, n):
+        """Label mask zero-weighting padded rows [n:] so the jitted step's
+        loss averages over the n REAL examples only (exact equivalence with
+        the unpadded single-device fit; the loss denominator counts unmasked
+        entries — see losses.average_score).
+
+        Mask shape follows the label rank's masking convention: a user mask
+        is multiplied by row validity; absent one, rank-2/3 labels get a
+        per-example [B] weight (which keeps the unmasked sum/B denominator
+        — a [B,T] mask would flip average_score into its per-timestep
+        sum/sum(mask) branch and rescale gradients by 1/T), and rank-4
+        (CnnLossLayer) labels get the per-pixel [B,H,W] mask its score()
+        flattens."""
+        y = np.asarray(y)
+        total = len(y)
+        if total == n and lm is None:
+            return lm
+        valid = np.zeros(total, np.float32)
+        valid[:n] = 1.0
+        if lm is not None:
+            lm = np.asarray(lm, np.float32)
+            return lm * valid.reshape([total] + [1] * (lm.ndim - 1))
+        if y.ndim == 4:
+            return np.broadcast_to(valid[:, None, None], y.shape[:3]).copy()
+        return valid
 
     def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None):
         """Data-parallel fit: identical semantics to ``model.fit`` on a batch
@@ -93,8 +124,10 @@ class ParallelWrapper:
             source = data() if callable(data) else data
             for batch in _iter_batches(source, batch_size):
                 # pad so the batch shards exactly (the reference round-robins
-                # whole DataSets to workers; here the split must be even)
+                # whole DataSets to workers; here the split must be even),
+                # then zero-weight the padded rows in the loss
                 (x, y, fm, lm), n = self._pad_to_shardable(batch)
+                lm = self._padded_lmask(y, lm, n)
                 score = model._fit_batch(
                     self._shard(x), self._shard(y), self._shard(fm), self._shard(lm)
                 )
@@ -124,6 +157,14 @@ class ParallelWrapper:
                     fm, _ = self._pad_to_shardable(fm)
                 if lm is not None:
                     lm, _ = self._pad_to_shardable(lm)
+                if lbl is not None:
+                    # zero-weight padded rows in every output's loss
+                    lms = lm if lm is not None else (None,) * len(lbl)
+                    lm = tuple(
+                        self._padded_lmask(yi, lmi, n) for yi, lmi in zip(lbl, lms)
+                    )
+                    if all(m is None for m in lm):
+                        lm = None
                 score = model.fit_batch(
                     (shard_t(f), shard_t(lbl), shard_t(fm), shard_t(lm))
                 )

@@ -21,6 +21,14 @@ from __graft_entry__ import _provision_cpu_mesh  # noqa: E402
 _provision_cpu_mesh(8)
 
 import jax  # noqa: E402  (import after env vars so they take effect)
+
+# Persistent compilation cache: jit programs recompile identically across
+# test runs (and across rounds), so pay each XLA compile once, not per run.
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -70,12 +70,50 @@ class TestParallelWrapper:
         pw.fit((x, y), epochs=20, batch_size=64)
         assert model.score(x, y) < s0 * 0.8
 
-    def test_uneven_batch_padding(self):
+    def test_uneven_batch_padding_exact(self):
+        """Uneven batch (60 % 8 != 0): padded rows must be zero-weighted, so
+        DP fit equals single-device fit on the same 60 examples — not just
+        'it ran' (the old padding duplicated samples into the gradient)."""
         x, y = _data(60)  # not divisible by 8
-        model = _model()
-        pw = ParallelWrapper(model, mesh=make_mesh(MeshSpec(data=8)))
-        pw.fit((x, y), epochs=1)
-        assert model.iteration == 1
+        m1 = _model(seed=5)
+        m2 = _model(seed=5)
+        m1.fit((x, y), epochs=5)
+        pw = ParallelWrapper(m2, mesh=make_mesh(MeshSpec(data=8)))
+        pw.fit((x, y), epochs=5)
+        assert m2.iteration == 5
+        for a, b in zip(
+            jax.tree_util.tree_leaves(m1.params), jax.tree_util.tree_leaves(m2.params)
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5)
+
+    def test_uneven_batch_rnn_labels_exact(self):
+        """Rank-3 (time-series) labels on the uneven path: the synthetic
+        validity mask must keep the unmasked sum/B loss denominator, not
+        flip into per-timestep averaging (which would rescale grads by 1/T)."""
+        from deeplearning4j_tpu.nn.layers import SimpleRnn, RnnOutputLayer
+
+        def mk():
+            conf = MultiLayerConfiguration(
+                layers=(
+                    SimpleRnn(n_out=8, activation="tanh"),
+                    RnnOutputLayer(n_out=3, activation="softmax"),
+                ),
+                input_type=InputType.recurrent(4),
+                updater={"type": "sgd", "lr": 0.1},
+                seed=7,
+            )
+            return MultiLayerNetwork(conf).init()
+
+        rs = np.random.RandomState(1)
+        x = rs.randn(20, 6, 4).astype(np.float32)  # 20 % 8 != 0
+        y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, (20, 6))]
+        m1, m2 = mk(), mk()
+        m1.fit((x, y), epochs=3)
+        ParallelWrapper(m2, mesh=make_mesh(MeshSpec(data=8))).fit((x, y), epochs=3)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(m1.params), jax.tree_util.tree_leaves(m2.params)
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5)
 
     def test_sharded_output(self):
         x, y = _data(32)
