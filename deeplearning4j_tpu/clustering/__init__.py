@@ -1,0 +1,34 @@
+"""Nearest-neighbors + clustering suite (TPU-native).
+
+Capability parity with the reference's
+deeplearning4j-nearestneighbors-parent/nearestneighbor-core
+(clustering/vptree/VPTree.java:48, kdtree/KDTree.java, kmeans/KMeansClustering.java,
+lsh/RandomProjectionLSH.java) and deeplearning4j-core's plot/BarnesHutTsne.java:65.
+
+TPU-first redesign (SURVEY.md §7 "hard parts"): the reference's trees are
+pointer-chasing CPU structures; on TPU the same exact-search capability is a
+batched brute-force top-k (one fused matmul + top_k per corpus chunk, MXU
+friendly, streamed over HBM). VPTree/KDTree remain as exact-API shims over
+that kernel so reference users find the classes they expect.
+"""
+
+from deeplearning4j_tpu.clustering.knn import knn_search, pairwise_distance
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering, Cluster, ClusterSet
+from deeplearning4j_tpu.clustering.lsh import RandomProjectionLSH
+from deeplearning4j_tpu.clustering.server import NearestNeighborsServer
+from deeplearning4j_tpu.clustering.trees import KDTree, VPTree
+from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne, Tsne
+
+__all__ = [
+    "knn_search",
+    "pairwise_distance",
+    "KMeansClustering",
+    "Cluster",
+    "ClusterSet",
+    "RandomProjectionLSH",
+    "KDTree",
+    "VPTree",
+    "BarnesHutTsne",
+    "Tsne",
+    "NearestNeighborsServer",
+]

@@ -1,0 +1,115 @@
+"""Random-projection (signed) LSH for cosine distance.
+
+Capability parity with the reference's clustering/lsh/RandomProjectionLSH.java
+(hash/makeIndex/bucket/search for the cosine distance, with entropy-LSH
+query perturbation). TPU-first: hashing is one [N,D]x[D,H] matmul + sign;
+bucket matching is a jitted Hamming-agreement reduction over all tables at
+once instead of per-table Java loops.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.clustering.knn import knn_search, pairwise_distance
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _signs(data, proj):
+    return (data @ proj >= 0.0).astype(jnp.uint8)  # [N, tables*hash_len]
+
+
+@functools.partial(jax.jit, static_argnames=("num_tables", "hash_length"))
+def _bucket_mask(index_hash, query_hash, num_tables: int, hash_length: int):
+    """Row i is in the query's bucket iff SOME table agrees on all bits."""
+    ih = index_hash.reshape(-1, num_tables, hash_length)
+    qh = query_hash.reshape(num_tables, hash_length)
+    agree = jnp.all(ih == qh[None], axis=2)          # [N, tables]
+    return jnp.any(agree, axis=1)                    # [N]
+
+
+class RandomProjectionLSH:
+    """``RandomProjectionLSH(hash_length, num_tables, in_dimension, radius)``
+    (reference RandomProjectionLSH.java:75). ``radius`` drives entropy-LSH
+    perturbation sampling in ``entropy``; search falls back to exact scan
+    when a bucket is empty (the reference raises — we degrade gracefully and
+    stay exact)."""
+
+    def __init__(self, hash_length: int, num_tables: int, in_dimension: int,
+                 radius: float = 0.1, seed: int = 12345):
+        self.hash_length = int(hash_length)
+        self.num_tables = int(num_tables)
+        self.in_dimension = int(in_dimension)
+        self.radius = float(radius)
+        rs = np.random.RandomState(seed)
+        self.projection = jnp.asarray(
+            rs.randn(in_dimension, num_tables * hash_length).astype(np.float32)
+            / np.sqrt(in_dimension)
+        )
+        self._rs = rs
+        self.index_data: Optional[np.ndarray] = None
+        self.index_hash: Optional[jnp.ndarray] = None
+
+    # -- hashing -----------------------------------------------------------
+    def hash(self, data) -> np.ndarray:
+        data = np.atleast_2d(np.asarray(data, np.float32))
+        return np.asarray(_signs(jnp.asarray(data), self.projection))
+
+    def entropy(self, x) -> np.ndarray:
+        """Entropy-LSH query offsets: points sampled on the sphere of radius
+        ``radius`` around x (reference RandomProjectionLSH.entropy:106)."""
+        x = np.asarray(x, np.float32).reshape(-1)
+        pert = self._rs.randn(self.num_tables, x.shape[0]).astype(np.float32)
+        pert /= np.maximum(np.linalg.norm(pert, axis=1, keepdims=True), 1e-12)
+        return x[None, :] + self.radius * pert
+
+    # -- index -------------------------------------------------------------
+    def make_index(self, data) -> None:
+        self.index_data = np.asarray(data, np.float32)
+        self.index_hash = jnp.asarray(self.hash(self.index_data))
+
+    def _require_index(self):
+        if self.index_data is None:
+            raise RuntimeError("call make_index(data) first")
+
+    def bucket(self, query) -> np.ndarray:
+        """Boolean row mask of index points sharing a hash bucket with the
+        query under ANY table, including entropy perturbations."""
+        self._require_index()
+        qs = np.vstack([np.atleast_2d(np.asarray(query, np.float32)),
+                        self.entropy(query)])
+        mask = np.zeros(self.index_data.shape[0], bool)
+        for qh in self.hash(qs):
+            mask |= np.asarray(
+                _bucket_mask(self.index_hash, jnp.asarray(qh),
+                             self.num_tables, self.hash_length)
+            )
+        return mask
+
+    # -- search ------------------------------------------------------------
+    def search(self, query, k: Optional[int] = None,
+               max_range: Optional[float] = None) -> np.ndarray:
+        """Bucketed cosine-distance search: ``k`` nearest (search(query, k),
+        reference :212) or all within ``max_range`` (search(query, maxRange),
+        reference :191). Returns the matching index rows, nearest first."""
+        self._require_index()
+        mask = self.bucket(query)
+        cand_idx = np.nonzero(mask)[0]
+        if cand_idx.size == 0:
+            cand_idx = np.arange(self.index_data.shape[0])
+        cand = self.index_data[cand_idx]
+        d = np.asarray(
+            pairwise_distance(np.atleast_2d(np.asarray(query, np.float32)),
+                              cand, "cosinedistance")
+        )[0]
+        order = np.argsort(d)
+        if k is not None:
+            order = order[: min(k, order.size)]
+        elif max_range is not None:
+            order = order[d[order] <= max_range]
+        return self.index_data[cand_idx[order]]

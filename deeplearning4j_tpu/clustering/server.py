@@ -1,0 +1,105 @@
+"""Nearest-neighbors HTTP server.
+
+Capability parity with the reference's nearestneighbor-server
+(NearestNeighborsServer: POST /knn for an already-indexed row, POST /knnnew
+for a raw vector; JSON request/response DTOs). Stdlib ThreadingHTTPServer —
+no framework dependency; the search itself is the jitted batched top-k
+(clustering/knn.py), so concurrent requests share one compiled kernel.
+
+POST /knn     {"ndarray": <row index>, "k": 5}
+POST /knnnew  {"ndarray": [..vector..], "k": 5}
+Response      {"results": [{"index": i, "distance": d}, ...]}
+GET  /status  {"ok": true, "points": N, "dim": D}
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.clustering.knn import knn_search
+
+
+class NearestNeighborsServer:
+    """``NearestNeighborsServer(points, similarity_function).start(port)``;
+    ``stop()`` to shut down. Port 0 picks a free port (see ``.port``)."""
+
+    def __init__(self, points, similarity_function: str = "euclidean",
+                 invert: bool = False):
+        self.points = np.asarray(points, np.float32)
+        self.similarity_function = similarity_function
+        self.invert = invert
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+
+    def _search(self, vec: np.ndarray, k: int):
+        idx, dist = knn_search(self.points, vec[None, :], k,
+                               metric=self.similarity_function)
+        return [
+            {"index": int(i), "distance": float(d)}
+            for i, d in zip(idx[0], dist[0])
+        ]
+
+    def start(self, port: int = 9000) -> "NearestNeighborsServer":
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silent: tests spin servers up/down
+                pass
+
+            def _reply(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/status":
+                    self._reply(200, {"ok": True,
+                                      "points": int(outer.points.shape[0]),
+                                      "dim": int(outer.points.shape[1])})
+                else:
+                    self._reply(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    k = int(req.get("k", 1))
+                    if self.path == "/knn":
+                        row = int(req["ndarray"])
+                        vec = outer.points[row]
+                        results = outer._search(vec, k + 1)
+                        # drop the query row itself (reference /knn semantics)
+                        results = [r for r in results if r["index"] != row][:k]
+                    elif self.path == "/knnnew":
+                        vec = np.asarray(req["ndarray"], np.float32).reshape(-1)
+                        results = outer._search(vec, k)
+                    else:
+                        self._reply(404, {"error": "unknown path"})
+                        return
+                    self._reply(200, {"results": results})
+                except Exception as e:  # bad request payloads
+                    self._reply(400, {"error": str(e)})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            if self._thread:
+                self._thread.join(timeout=10)
+                self._thread = None

@@ -1,0 +1,26 @@
+"""Graph embeddings (deeplearning4j-graph parity).
+
+Reference: deeplearning4j-graph/src/main/java/org/deeplearning4j/graph/ —
+api/IGraph + graph/Graph (adjacency lists), iterator/RandomWalkIterator
+(+ weighted), data/GraphLoader (edge-list files), models/deepwalk/DeepWalk
+(+ GraphHuffman). TPU-first: walks are generated host-side (cheap, int
+indexing) and batched into fixed-shape (center, huffman path) arrays; the
+hierarchical-softmax update is ONE jitted step per batch instead of the
+reference's per-pair Java thread workers.
+"""
+
+from deeplearning4j_tpu.graph.api import Edge, Graph, Vertex
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk, GraphHuffman
+from deeplearning4j_tpu.graph.loader import GraphLoader
+from deeplearning4j_tpu.graph.walks import RandomWalkIterator, WeightedRandomWalkIterator
+
+__all__ = [
+    "Edge",
+    "Graph",
+    "Vertex",
+    "DeepWalk",
+    "GraphHuffman",
+    "GraphLoader",
+    "RandomWalkIterator",
+    "WeightedRandomWalkIterator",
+]

@@ -1,0 +1,202 @@
+"""DeepWalk graph embeddings.
+
+Reference: graph/models/deepwalk/DeepWalk.java (initialize(graph) builds a
+Huffman tree over vertex degrees via GraphHuffman.java, fit(graph,
+walk_length) trains skip-gram hierarchical softmax over random-walk
+windows). TPU-first: walk windows are batched into (center, huffman
+path) index arrays and updated by the SAME jitted HS step the word2vec
+stack uses (nlp/embeddings._sg_hs_step) — one fused gather/einsum/scatter
+program per batch instead of per-pair Java threads.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.graph.api import Graph
+from deeplearning4j_tpu.graph.walks import RandomWalkIterator
+from deeplearning4j_tpu.nlp.embeddings import _sg_hs_step
+
+
+class GraphHuffman:
+    """Huffman coding of vertices by degree (GraphHuffman.java): frequent
+    (high-degree) vertices get short codes. Produces padded [V, L] code /
+    inner-node-index / mask tables for the batched HS step."""
+
+    def __init__(self, counts: np.ndarray, max_code_length: int = 64):
+        counts = np.asarray(counts, np.int64)
+        n = len(counts)
+        # standard two-queue-free heap Huffman over (count, tiebreak, node)
+        heap: List[Tuple[int, int, int]] = [(int(c), i, i) for i, c in enumerate(counts)]
+        heapq.heapify(heap)
+        parent = np.full(2 * n - 1, -1, np.int64)
+        binary = np.zeros(2 * n - 1, np.int8)
+        nxt = n
+        while len(heap) > 1:
+            c1, _, n1 = heapq.heappop(heap)
+            c2, _, n2 = heapq.heappop(heap)
+            parent[n1] = nxt
+            parent[n2] = nxt
+            binary[n2] = 1
+            heapq.heappush(heap, (c1 + c2, nxt, nxt))
+            nxt += 1
+        root = 2 * n - 2
+        codes = np.zeros((n, max_code_length), np.float32)
+        points = np.zeros((n, max_code_length), np.int32)
+        mask = np.zeros((n, max_code_length), np.float32)
+        self.code_lengths = np.zeros(n, np.int32)
+        for v in range(n):
+            path_bits: List[int] = []
+            path_nodes: List[int] = []
+            node = v
+            while parent[node] != -1:
+                path_bits.append(int(binary[node]))
+                path_nodes.append(int(parent[node]) - n)  # inner node id
+                node = parent[node]
+            path_bits.reverse()
+            path_nodes.reverse()
+            L = min(len(path_bits), max_code_length)
+            self.code_lengths[v] = L
+            codes[v, :L] = path_bits[:L]
+            points[v, :L] = path_nodes[:L]
+            mask[v, :L] = 1.0
+        self.codes, self.points, self.mask = codes, points, mask
+        self.num_inner = max(n - 1, 1)
+
+    def get_code_length(self, vertex: int) -> int:
+        return int(self.code_lengths[vertex])
+
+
+class DeepWalk:
+    """``DeepWalk(vector_size=100, window_size=5, learning_rate=0.025)``;
+    ``initialize(graph)`` then ``fit(graph, walk_length)``
+    (DeepWalk.java:67,95). GraphVectors surface: ``get_vertex_vector``,
+    ``similarity``, ``vertices_nearest``."""
+
+    def __init__(self, vector_size: int = 100, window_size: int = 5,
+                 learning_rate: float = 0.025, batch_size: int = 512,
+                 seed: int = 12345):
+        self.vector_size = vector_size
+        self.window_size = window_size
+        self.learning_rate = learning_rate
+        self.batch_size = batch_size
+        self.seed = seed
+        self.huffman: Optional[GraphHuffman] = None
+        self.params: Optional[dict] = None
+        self._step = None
+        self._rs = np.random.RandomState(seed)
+
+    # -- init --------------------------------------------------------------
+    def initialize(self, graph_or_degrees) -> "DeepWalk":
+        degrees = (
+            graph_or_degrees.degrees()
+            if isinstance(graph_or_degrees, Graph)
+            else np.asarray(graph_or_degrees, np.int64)
+        )
+        n = len(degrees)
+        self.huffman = GraphHuffman(np.maximum(degrees, 1))
+        rs = np.random.RandomState(self.seed)
+        self.params = {
+            "syn0": jnp.asarray(
+                (rs.rand(n, self.vector_size).astype(np.float32) - 0.5)
+                / self.vector_size
+            ),
+            "syn1": jnp.asarray(
+                np.zeros((self.huffman.num_inner, self.vector_size), np.float32)
+            ),
+        }
+        self._step = jax.jit(_sg_hs_step, donate_argnums=(0,))
+        return self
+
+    # -- training ----------------------------------------------------------
+    def _pairs_from_walk(self, walk: np.ndarray):
+        w = self.window_size
+        for i, center in enumerate(walk):
+            lo, hi = max(0, i - w), min(len(walk), i + w + 1)
+            for j in range(lo, hi):
+                if j != i:
+                    yield int(center), int(walk[j])
+
+    def fit(self, graph_or_iterator, walk_length: int = 40,
+            epochs: int = 1) -> "DeepWalk":
+        if isinstance(graph_or_iterator, Graph):
+            if self.params is None:
+                self.initialize(graph_or_iterator)
+            n_vertices = graph_or_iterator.num_vertices()
+            make_it = lambda ep: RandomWalkIterator(
+                graph_or_iterator, walk_length, seed=self.seed + ep
+            )
+        else:
+            if self.params is None:
+                raise RuntimeError("call initialize(graph) before fit(iterator)")
+            n_vertices = self.syn0.shape[0]
+            make_it = lambda ep: graph_or_iterator
+        codes = jnp.asarray(self.huffman.codes)
+        points = jnp.asarray(self.huffman.points)
+        hmask = jnp.asarray(self.huffman.mask)
+        # word2vec-style linear lr decay over the expected pair count; a
+        # batched scatter-add applies MANY same-vertex updates at once, so a
+        # constant lr diverges on small dense graphs
+        total_pairs = max(
+            epochs * n_vertices * (walk_length + 1) * 2 * self.window_size, 1
+        )
+        seen = 0
+        for ep in range(epochs):
+            buf_c: List[int] = []
+            buf_t: List[int] = []
+            for walk in make_it(ep):
+                for c, t in self._pairs_from_walk(walk):
+                    buf_c.append(c)
+                    buf_t.append(t)
+                    if len(buf_c) == self.batch_size:
+                        seen += len(buf_c)
+                        self._apply(buf_c, buf_t, codes, points, hmask,
+                                    self._lr_at(seen, total_pairs))
+                        buf_c, buf_t = [], []
+            if buf_c:
+                seen += len(buf_c)
+                self._apply(buf_c, buf_t, codes, points, hmask,
+                            self._lr_at(seen, total_pairs))
+        return self
+
+    def _lr_at(self, seen: int, total: int) -> float:
+        frac = min(seen / total, 1.0)
+        return max(self.learning_rate * (1.0 - frac), self.learning_rate * 1e-2)
+
+    def _apply(self, centers, targets, codes, points, hmask, lr):
+        c = jnp.asarray(np.asarray(centers, np.int32))
+        t = np.asarray(targets, np.int32)
+        self.params, _ = self._step(
+            self.params, c, codes[t], points[t], hmask[t],
+            jnp.asarray(lr, jnp.float32),
+        )
+
+    # -- GraphVectors surface ---------------------------------------------
+    @property
+    def syn0(self) -> np.ndarray:
+        return np.asarray(self.params["syn0"])
+
+    def get_vertex_vector(self, idx: int) -> np.ndarray:
+        return self.syn0[idx]
+
+    def num_vertices(self) -> int:
+        return self.syn0.shape[0]
+
+    def similarity(self, a: int, b: int) -> float:
+        va, vb = self.syn0[a], self.syn0[b]
+        denom = np.linalg.norm(va) * np.linalg.norm(vb)
+        return float(va @ vb / denom) if denom > 0 else 0.0
+
+    def vertices_nearest(self, idx: int, top_n: int = 10) -> List[int]:
+        m = self.syn0
+        v = m[idx]
+        sims = (m @ v) / np.maximum(
+            np.linalg.norm(m, axis=1) * max(np.linalg.norm(v), 1e-12), 1e-12
+        )
+        order = [int(i) for i in np.argsort(-sims) if i != idx]
+        return order[:top_n]

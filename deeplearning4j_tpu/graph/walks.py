@@ -1,0 +1,84 @@
+"""Random-walk iterators over a Graph.
+
+Reference surface: graph/iterator/RandomWalkIterator.java (uniform next-hop)
+and WeightedRandomWalkIterator.java (edge-weight-proportional next-hop),
+with NoEdgeHandling SELF_LOOP_ON_DISCONNECTED | EXCEPTION_ON_DISCONNECTED.
+Each ``next()`` yields one fixed-length walk of vertex indices; one epoch
+visits every vertex as a start exactly once (shuffled order).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.graph.api import Graph
+
+SELF_LOOP_ON_DISCONNECTED = "self_loop"
+EXCEPTION_ON_DISCONNECTED = "exception"
+
+
+class NoEdgesException(RuntimeError):
+    pass
+
+
+class RandomWalkIterator:
+    """Uniform random walks of ``walk_length`` hops from every vertex."""
+
+    def __init__(self, graph: Graph, walk_length: int, seed: int = 12345,
+                 no_edge_handling: str = SELF_LOOP_ON_DISCONNECTED):
+        self.graph = graph
+        self.walk_length = int(walk_length)
+        self.no_edge_handling = no_edge_handling
+        self._rs = np.random.RandomState(seed)
+        self.reset()
+
+    def reset(self) -> None:
+        self._order = self._rs.permutation(self.graph.num_vertices())
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._order)
+
+    def _step(self, cur: int) -> int:
+        nbrs = self.graph.get_connected_vertex_indices(cur)
+        if not nbrs:
+            if self.no_edge_handling == EXCEPTION_ON_DISCONNECTED:
+                raise NoEdgesException(f"vertex {cur} is disconnected")
+            return cur  # self loop
+        return nbrs[self._rs.randint(len(nbrs))]
+
+    def next(self) -> np.ndarray:
+        """Walk of walk_length+1 vertex indices (start included)."""
+        if not self.has_next():
+            raise StopIteration
+        cur = int(self._order[self._pos])
+        self._pos += 1
+        walk = np.empty(self.walk_length + 1, np.int64)
+        walk[0] = cur
+        for i in range(1, self.walk_length + 1):
+            cur = self._step(cur)
+            walk[i] = cur
+        return walk
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        while self.has_next():
+            yield self.next()
+
+
+class WeightedRandomWalkIterator(RandomWalkIterator):
+    """Next hop drawn proportional to edge weight
+    (WeightedRandomWalkIterator.java)."""
+
+    def _step(self, cur: int) -> int:
+        nbrs = self.graph.get_connected_vertex_indices(cur)
+        if not nbrs:
+            if self.no_edge_handling == EXCEPTION_ON_DISCONNECTED:
+                raise NoEdgesException(f"vertex {cur} is disconnected")
+            return cur
+        w = np.asarray(self.graph.get_edge_weights(cur), np.float64)
+        tot = w.sum()
+        if tot <= 0:
+            return nbrs[self._rs.randint(len(nbrs))]
+        return nbrs[self._rs.choice(len(nbrs), p=w / tot)]

@@ -1,0 +1,261 @@
+"""Nearest-neighbors / clustering / t-SNE / DeepWalk tests — parity vs
+numpy oracles (VERDICT round-1 item 5; reference test model: knn (7 files),
+graph (5 files) suites)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    BarnesHutTsne,
+    KDTree,
+    KMeansClustering,
+    NearestNeighborsServer,
+    RandomProjectionLSH,
+    VPTree,
+    knn_search,
+    pairwise_distance,
+)
+from deeplearning4j_tpu.graph import (
+    DeepWalk,
+    Graph,
+    GraphLoader,
+    RandomWalkIterator,
+    WeightedRandomWalkIterator,
+)
+
+
+class TestKnn:
+    def _oracle_l2(self, corpus, q):
+        return np.sqrt(((corpus[None] - q[:, None]) ** 2).sum(-1))
+
+    def test_pairwise_matches_numpy(self, rng):
+        c = rng.randn(40, 8).astype(np.float32)
+        q = rng.randn(7, 8).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(pairwise_distance(q, c, "euclidean")),
+            self._oracle_l2(c, q), rtol=1e-4, atol=1e-4,
+        )
+        cs = np.asarray(pairwise_distance(q, c, "cosinesimilarity"))
+        oracle = (q / np.linalg.norm(q, axis=1, keepdims=True)) @ (
+            c / np.linalg.norm(c, axis=1, keepdims=True)
+        ).T
+        np.testing.assert_allclose(cs, oracle, rtol=1e-4, atol=1e-5)
+
+    def test_topk_exact(self, rng):
+        c = rng.randn(100, 5).astype(np.float32)
+        q = rng.randn(3, 5).astype(np.float32)
+        idx, dist = knn_search(c, q, k=10)
+        oracle = self._oracle_l2(c, q)
+        for i in range(3):
+            expect = np.argsort(oracle[i])[:10]
+            np.testing.assert_array_equal(np.sort(idx[i]), np.sort(expect))
+            np.testing.assert_allclose(dist[i], oracle[i][idx[i]], rtol=1e-4, atol=1e-4)
+            assert np.all(np.diff(dist[i]) >= -1e-5)  # best first
+
+    def test_chunked_matches_unchunked(self, rng):
+        c = rng.randn(230, 6).astype(np.float32)
+        q = rng.randn(4, 6).astype(np.float32)
+        i1, d1 = knn_search(c, q, k=7)
+        i2, d2 = knn_search(c, q, k=7, chunk_size=50)
+        np.testing.assert_allclose(d1, d2, rtol=1e-5, atol=1e-6)
+        np.testing.assert_array_equal(i1, i2)
+
+
+class TestTrees:
+    def test_vptree_search(self, rng):
+        items = rng.randn(60, 4).astype(np.float32)
+        t = VPTree(items)
+        target = rng.randn(4).astype(np.float32)
+        got_items, got_d = t.search(target, 5)
+        oracle = np.linalg.norm(items - target, axis=1)
+        expect = np.argsort(oracle)[:5]
+        np.testing.assert_allclose(got_d, oracle[expect], rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(got_items, items[expect], rtol=1e-5)
+
+    def test_vptree_invert(self, rng):
+        items = rng.randn(30, 4).astype(np.float32)
+        t = VPTree(items, invert=True)
+        target = np.zeros(4, np.float32)
+        _, d = t.search(target, 3)
+        oracle = np.linalg.norm(items, axis=1)
+        np.testing.assert_allclose(d, np.sort(oracle)[::-1][:3], rtol=1e-4)
+
+    def test_kdtree_insert_nn_knn_delete(self, rng):
+        kt = KDTree(3)
+        pts = rng.randn(20, 3).astype(np.float32)
+        for p in pts:
+            kt.insert(p)
+        assert kt.size() == 20
+        q = pts[7] + 1e-4
+        d, p = kt.nn(q)
+        np.testing.assert_allclose(p, pts[7], rtol=1e-5)
+        within = kt.knn(q, 1.0)
+        oracle = np.linalg.norm(pts - q, axis=1)
+        assert len(within) == int((oracle <= 1.0).sum())
+        assert within[0][0] <= within[-1][0]
+        assert kt.delete(pts[7])
+        assert kt.size() == 19
+
+
+class TestKMeans:
+    def test_separates_blobs(self, rng):
+        blobs = np.concatenate([
+            rng.randn(40, 2).astype(np.float32) + [0, 0],
+            rng.randn(40, 2).astype(np.float32) + [12, 0],
+            rng.randn(40, 2).astype(np.float32) + [0, 12],
+        ])
+        cs = KMeansClustering.setup(3, 50, "euclidean").apply_to(blobs)
+        labels = cs.assignments
+        # each blob maps to exactly one cluster id
+        for s in range(0, 120, 40):
+            blk = labels[s : s + 40]
+            assert (blk == np.bincount(blk).argmax()).mean() > 0.95
+        assert len(cs.clusters) == 3
+        assert sum(c.count for c in cs.clusters) == 120
+        assert cs.nearest_cluster(np.array([11.5, 0.5])) == labels[40]
+
+    def test_rejects_similarity_metric(self):
+        with pytest.raises(ValueError):
+            KMeansClustering.setup(2, 10, "cosinesimilarity")
+
+
+class TestLSH:
+    def test_search_finds_near_duplicates(self, rng):
+        base = rng.randn(200, 16).astype(np.float32)
+        lsh = RandomProjectionLSH(hash_length=8, num_tables=6, in_dimension=16,
+                                  radius=0.1, seed=7)
+        lsh.make_index(base)
+        q = base[13] + 1e-3 * rng.randn(16).astype(np.float32)
+        got = lsh.search(q, k=1)
+        np.testing.assert_allclose(got[0], base[13], rtol=1e-4)
+
+    def test_bucket_and_range_search(self, rng):
+        base = rng.randn(100, 8).astype(np.float32)
+        lsh = RandomProjectionLSH(4, 4, 8, radius=0.05, seed=3)
+        lsh.make_index(base)
+        mask = lsh.bucket(base[5])
+        assert mask[5]  # a point is always in its own bucket
+        res = lsh.search(base[5], max_range=0.0 + 1e-6)
+        np.testing.assert_allclose(res[0], base[5], rtol=1e-5)
+
+    def test_hash_shape(self, rng):
+        lsh = RandomProjectionLSH(8, 3, 10)
+        h = lsh.hash(rng.randn(5, 10).astype(np.float32))
+        assert h.shape == (5, 24) and set(np.unique(h)) <= {0, 1}
+
+
+class TestTsne:
+    def test_separates_two_clusters(self, rng):
+        x = np.concatenate([
+            rng.randn(25, 10).astype(np.float32),
+            rng.randn(25, 10).astype(np.float32) + 8.0,
+        ])
+        emb = BarnesHutTsne(perplexity=10.0, n_iter=1000, seed=1).fit_transform(x)
+        assert emb.shape == (50, 2)
+        assert np.all(np.isfinite(emb))
+        a, b = emb[:25], emb[25:]
+        intra = max(np.linalg.norm(a - a.mean(0), axis=1).mean(),
+                    np.linalg.norm(b - b.mean(0), axis=1).mean())
+        inter = np.linalg.norm(a.mean(0) - b.mean(0))
+        assert inter > 2.0 * intra  # clusters stay separated in the embedding
+
+
+class TestGraph:
+    def _ring(self, n=10):
+        g = Graph(n)
+        for i in range(n):
+            g.add_edge(i, (i + 1) % n)
+        return g
+
+    def test_graph_api(self):
+        g = self._ring(6)
+        assert g.num_vertices() == 6
+        assert sorted(g.get_connected_vertex_indices(0)) == [1, 5]
+        assert g.get_vertex_degree(3) == 2
+        assert g.degrees().tolist() == [2] * 6
+
+    def test_random_walks_cover_and_respect_edges(self):
+        g = self._ring(8)
+        it = RandomWalkIterator(g, walk_length=5, seed=0)
+        starts = []
+        for walk in it:
+            starts.append(walk[0])
+            assert len(walk) == 6
+            for a, b in zip(walk, walk[1:]):
+                assert abs(int(a) - int(b)) % 8 in (1, 7)  # ring edges only
+        assert sorted(starts) == list(range(8))  # each vertex starts once
+
+    def test_weighted_walk_prefers_heavy_edge(self):
+        g = Graph(3)
+        g.add_edge(0, 1, weight=100.0)
+        g.add_edge(0, 2, weight=0.01)
+        it = WeightedRandomWalkIterator(g, walk_length=1, seed=0)
+        hits = [w[1] for w in it if w[0] == 0]
+        assert hits and hits[0] == 1
+
+    def test_loader(self, tmp_path):
+        p = tmp_path / "edges.txt"
+        p.write_text("# comment\n0 1\n1 2 3.5\n\n2 0\n")
+        g = GraphLoader.load_undirected_graph_edge_list_file(str(p), 3)
+        assert g.get_vertex_degree(1) == 2
+        assert 3.5 in g.get_edge_weights(1)
+
+
+class TestDeepWalk:
+    def test_two_cliques_embed_apart(self):
+        # two 6-cliques joined by one bridge edge: same-clique similarity
+        # must exceed cross-clique similarity after training
+        g = Graph(12)
+        for s in (0, 6):
+            for i in range(s, s + 6):
+                for j in range(i + 1, s + 6):
+                    g.add_edge(i, j)
+        g.add_edge(0, 6)
+        dw = DeepWalk(vector_size=16, window_size=3, learning_rate=0.05, seed=4)
+        dw.fit(g, walk_length=20, epochs=12)
+        same = np.mean([dw.similarity(1, j) for j in range(2, 6)])
+        cross = np.mean([dw.similarity(1, j) for j in range(7, 12)])
+        assert same > cross
+        near = dw.vertices_nearest(1, top_n=4)
+        assert len(set(near) & set(range(6))) == 4
+
+    def test_huffman_codes(self):
+        from deeplearning4j_tpu.graph.deepwalk import GraphHuffman
+        h = GraphHuffman(np.array([50, 30, 10, 5, 5]))
+        # most frequent vertex gets the shortest code
+        assert h.get_code_length(0) <= h.get_code_length(3)
+        assert h.mask.sum() > 0 and h.codes.shape == h.points.shape
+
+
+class TestNNServer:
+    def test_http_endpoints(self, rng):
+        pts = rng.randn(30, 4).astype(np.float32)
+        srv = NearestNeighborsServer(pts).start(port=0)
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            with urllib.request.urlopen(base + "/status", timeout=10) as r:
+                st = json.loads(r.read())
+            assert st == {"ok": True, "points": 30, "dim": 4}
+
+            req = urllib.request.Request(
+                base + "/knnnew",
+                data=json.dumps({"ndarray": pts[3].tolist(), "k": 2}).encode(),
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                res = json.loads(r.read())["results"]
+            assert res[0]["index"] == 3 and res[0]["distance"] < 1e-4
+
+            req = urllib.request.Request(
+                base + "/knn",
+                data=json.dumps({"ndarray": 3, "k": 2}).encode(),
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                res = json.loads(r.read())["results"]
+            assert len(res) == 2 and all(r_["index"] != 3 for r_ in res)
+        finally:
+            srv.stop()
