@@ -1,0 +1,19 @@
+"""Keras model import (deeplearning4j-modelimport parity).
+
+Reference: deeplearning4j-modelimport/src/main/java/org/deeplearning4j/nn/
+modelimport/keras/KerasModelImport.java:50-121 (importKerasSequentialModel*
+-> MultiLayerNetwork, importKerasModel* -> ComputationGraph), Hdf5Archive.java:46,
+per-layer converters under layers/**.
+"""
+
+from deeplearning4j_tpu.modelimport.keras import (
+    InvalidKerasConfigurationError,
+    KerasModelImport,
+    UnsupportedKerasConfigurationError,
+)
+
+__all__ = [
+    "KerasModelImport",
+    "InvalidKerasConfigurationError",
+    "UnsupportedKerasConfigurationError",
+]
