@@ -1,0 +1,26 @@
+"""Observability stack (deeplearning4j-ui-parent parity).
+
+Reference chain: StatsListener (ui-model/.../stats/BaseStatsListener.java:43,
+iterationDone:304 — score, per-param histograms/means/stdev of
+weights/updates, memory, timing) -> StatsStorageRouter -> StatsStorage impls
+(InMemory/File, ui/storage/) -> PlayUIServer train modules
+(/train/overview, /train/model, /train/system).
+
+TPU-first redesign: stats are plain JSON records (no SBE/Agrona binary
+encoding — that existed for JVM off-heap buffers); the dashboard is ONE
+self-contained static HTML file with inline SVG charts (no Play server, no
+external JS, works air-gapped), plus the same attach() surface so training
+jobs stream into storage and the page re-renders on demand.
+"""
+
+from deeplearning4j_tpu.ui.stats import StatsListener
+from deeplearning4j_tpu.ui.storage import FileStatsStorage, InMemoryStatsStorage, StatsStorage
+from deeplearning4j_tpu.ui.server import UIServer
+
+__all__ = [
+    "StatsListener",
+    "StatsStorage",
+    "InMemoryStatsStorage",
+    "FileStatsStorage",
+    "UIServer",
+]

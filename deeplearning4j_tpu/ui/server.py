@@ -1,0 +1,227 @@
+"""UIServer: dashboard rendering + attach API.
+
+Reference surface: UIServer.getInstance().attach(statsStorage)
+(deeplearning4j-play/.../api/UIServer.java:24,49) with train modules
+(/train/overview score+throughput, /train/model per-param charts,
+/train/system). Re-designed: render() emits ONE static self-contained HTML
+file (inline SVG, no JS dependencies, air-gap friendly); serve() optionally
+exposes it plus a JSON stats endpoint over stdlib HTTP.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu.ui.storage import StatsStorage
+
+_W, _H, _PAD = 640, 220, 42
+
+
+def _polyline(xs: Sequence[float], ys: Sequence[float], color: str) -> str:
+    if not xs:
+        return ""
+    x0, x1 = min(xs), max(xs)
+    y0, y1 = min(ys), max(ys)
+    sx = lambda x: _PAD + (x - x0) / (x1 - x0 or 1) * (_W - 2 * _PAD)
+    sy = lambda y: _H - _PAD - (y - y0) / (y1 - y0 or 1) * (_H - 2 * _PAD)
+    pts = " ".join(f"{sx(x):.1f},{sy(y):.1f}" for x, y in zip(xs, ys))
+    axis_labels = (
+        f'<text x="{_PAD}" y="{_H - 8}" class="ax">{x0:.4g}</text>'
+        f'<text x="{_W - _PAD}" y="{_H - 8}" class="ax" text-anchor="end">{x1:.4g}</text>'
+        f'<text x="4" y="{_H - _PAD}" class="ax">{y0:.4g}</text>'
+        f'<text x="4" y="{_PAD}" class="ax">{y1:.4g}</text>'
+    )
+    return (
+        f'<polyline fill="none" stroke="{color}" stroke-width="1.6" points="{pts}"/>'
+        + axis_labels
+    )
+
+
+def _chart(title: str, series: List[Tuple[str, Sequence[float], Sequence[float]]]) -> str:
+    colors = ["#1976d2", "#e53935", "#43a047", "#fb8c00", "#8e24aa",
+              "#00897b", "#6d4c41", "#3949ab"]
+    body, legend = [], []
+    for i, (label, xs, ys) in enumerate(series):
+        c = colors[i % len(colors)]
+        body.append(_polyline(list(xs), list(ys), c))
+        legend.append(f'<tspan fill="{c}">&#9632; {html.escape(label)}</tspan> ')
+    return (
+        f'<div class="card"><h3>{html.escape(title)}</h3>'
+        f'<svg viewBox="0 0 {_W} {_H}" width="{_W}" height="{_H}">'
+        f'<rect width="{_W}" height="{_H}" fill="#fafafa" stroke="#ddd"/>'
+        + "".join(body)
+        + f'<text x="{_PAD}" y="16" class="ax">{"".join(legend)}</text>'
+        "</svg></div>"
+    )
+
+
+def _histogram_svg(title: str, counts: Sequence[int], lo: float, hi: float) -> str:
+    if not counts:
+        return ""
+    w, h, pad = 300, 120, 24
+    n = len(counts)
+    mx = max(counts) or 1
+    bars = []
+    bw = (w - 2 * pad) / n
+    for i, c in enumerate(counts):
+        bh = (h - 2 * pad) * c / mx
+        bars.append(
+            f'<rect x="{pad + i * bw:.1f}" y="{h - pad - bh:.1f}" '
+            f'width="{max(bw - 1, 1):.1f}" height="{bh:.1f}" fill="#1976d2"/>'
+        )
+    return (
+        f'<div class="hist"><h4>{html.escape(title)}</h4>'
+        f'<svg viewBox="0 0 {w} {h}" width="{w}" height="{h}">'
+        f'<rect width="{w}" height="{h}" fill="#fafafa" stroke="#ddd"/>'
+        + "".join(bars)
+        + f'<text x="{pad}" y="{h - 6}" class="ax">{lo:.3g}</text>'
+        f'<text x="{w - pad}" y="{h - 6}" class="ax" text-anchor="end">{hi:.3g}</text>'
+        "</svg></div>"
+    )
+
+
+_CSS = """
+body { font-family: system-ui, sans-serif; margin: 20px; color: #222; }
+h1 { font-size: 20px; } h2 { font-size: 16px; margin-top: 28px; }
+h3 { font-size: 13px; margin: 6px 0; } h4 { font-size: 11px; margin: 4px 0; }
+.card { display: inline-block; margin: 8px; vertical-align: top; }
+.hist { display: inline-block; margin: 6px; }
+.ax { font-size: 9px; fill: #666; }
+table { border-collapse: collapse; font-size: 12px; }
+td, th { border: 1px solid #ccc; padding: 3px 8px; }
+"""
+
+
+class UIServer:
+    """``UIServer.get_instance().attach(storage)`` then ``render(path)`` or
+    ``serve(port)``."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self):
+        self.storages: List[StatsStorage] = []
+        self._httpd = None
+        self._thread = None
+        self.port: Optional[int] = None
+
+    @classmethod
+    def get_instance(cls) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def attach(self, storage: StatsStorage) -> "UIServer":
+        if storage not in self.storages:
+            self.storages.append(storage)
+        return self
+
+    def detach(self, storage: StatsStorage) -> None:
+        if storage in self.storages:
+            self.storages.remove(storage)
+
+    # -- rendering ---------------------------------------------------------
+    def render_html(self) -> str:
+        parts = [f"<html><head><meta charset='utf-8'><style>{_CSS}</style>"
+                 "<title>deeplearning4j_tpu training UI</title></head><body>"
+                 "<h1>Training overview</h1>"]
+        for storage in self.storages:
+            for sid in storage.list_session_ids():
+                parts.append(self._render_session(storage, sid))
+        parts.append("</body></html>")
+        return "".join(parts)
+
+    def _render_session(self, storage: StatsStorage, sid: str) -> str:
+        ups = [u for u in storage.get_all_updates(sid)
+               if u.get("type_id") == "StatsReport"]
+        statics = storage.get_static_info(sid)
+        parts = [f"<h2>Session {html.escape(sid)}</h2>"]
+        if statics:
+            s = statics[0]
+            rows = "".join(
+                f"<tr><th>{html.escape(str(k))}</th><td>{html.escape(str(v))}</td></tr>"
+                for k, v in s.items()
+                if k in ("model_class", "n_layers", "n_params", "backend", "devices")
+            )
+            parts.append(f"<table>{rows}</table>")
+        if not ups:
+            return "".join(parts)
+        its = [u["iteration"] for u in ups]
+        parts.append(_chart("Score vs iteration", [("score", its, [u["score"] for u in ups])]))
+        tput = [(u["iteration"], u["samples_per_sec"]) for u in ups
+                if u.get("samples_per_sec")]
+        if tput:
+            parts.append(_chart("Throughput (samples/sec)",
+                                [("samples/sec", [t[0] for t in tput], [t[1] for t in tput])]))
+        pnames = sorted(ups[-1].get("parameters", {}).keys())
+        if pnames:
+            parts.append(_chart(
+                "Parameter L2 norms",
+                [(n, its, [u["parameters"].get(n, {}).get("norm2", 0.0) for u in ups])
+                 for n in pnames],
+            ))
+            ratio_ups = [u for u in ups if u.get("update_ratios")]
+            if ratio_ups:
+                parts.append(_chart(
+                    "Update/parameter ratio (learning-rate health)",
+                    [(n, [u["iteration"] for u in ratio_ups],
+                      [u["update_ratios"].get(n, 0.0) for u in ratio_ups])
+                     for n in pnames],
+                ))
+            parts.append("<h2>Weight histograms (latest iteration)</h2>")
+            for n in pnames:
+                hg = ups[-1]["parameters"][n].get("histogram")
+                if hg:
+                    parts.append(_histogram_svg(n, hg["counts"], hg["lo"], hg["hi"]))
+        return "".join(parts)
+
+    def render(self, path: str) -> str:
+        """Write the dashboard to ``path``; returns the path."""
+        with open(path, "w") as f:
+            f.write(self.render_html())
+        return path
+
+    # -- serving -----------------------------------------------------------
+    def serve(self, port: int = 9001) -> "UIServer":
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path in ("/", "/train", "/train/overview"):
+                    body = outer.render_html().encode()
+                    ctype = "text/html"
+                elif self.path == "/stats":
+                    body = json.dumps([
+                        {"sessions": st.list_session_ids()} for st in outer.storages
+                    ]).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            if self._thread:
+                self._thread.join(timeout=10)
+                self._thread = None

@@ -1,0 +1,116 @@
+"""Stats storage: the record store the UI reads from.
+
+Reference surface: deeplearning4j-core api/storage/StatsStorage.java +
+StatsStorageRouter.java (putUpdate/putStaticInfo, listSessionIDs,
+getAllUpdatesAfter, listeners) and the ui/storage impls
+(InMemoryStatsStorage, FileStatsStorage). Records here are plain dicts
+with (session_id, type_id, worker_id, timestamp) keys; FileStatsStorage
+appends JSON lines so a crashed run's stats survive.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class StatsStorage:
+    """Router + query API (StatsStorageRouter / StatsStorage)."""
+
+    def __init__(self):
+        self._static: List[dict] = []
+        self._updates: List[dict] = []
+        self._listeners: List[Callable[[dict], None]] = []
+        self._lock = threading.Lock()
+
+    # -- router side -------------------------------------------------------
+    def put_static_info(self, record: dict) -> None:
+        record = dict(record, kind="static", timestamp=record.get("timestamp", time.time()))
+        with self._lock:
+            self._static.append(record)
+        self._notify(record)
+
+    def put_update(self, record: dict) -> None:
+        record = dict(record, kind="update", timestamp=record.get("timestamp", time.time()))
+        with self._lock:
+            self._updates.append(record)
+        self._notify(record)
+
+    def _notify(self, record: dict) -> None:
+        for cb in list(self._listeners):
+            cb(record)
+
+    def register_listener(self, cb: Callable[[dict], None]) -> None:
+        self._listeners.append(cb)
+
+    # -- query side --------------------------------------------------------
+    def list_session_ids(self) -> List[str]:
+        with self._lock:
+            return sorted({r["session_id"] for r in self._static + self._updates})
+
+    def list_worker_ids(self, session_id: str) -> List[str]:
+        with self._lock:
+            return sorted({
+                r.get("worker_id", "0") for r in self._updates
+                if r["session_id"] == session_id
+            })
+
+    def get_static_info(self, session_id: str) -> List[dict]:
+        with self._lock:
+            return [r for r in self._static if r["session_id"] == session_id]
+
+    def get_all_updates(self, session_id: str) -> List[dict]:
+        with self._lock:
+            return [r for r in self._updates if r["session_id"] == session_id]
+
+    def get_all_updates_after(self, session_id: str, timestamp: float) -> List[dict]:
+        return [r for r in self.get_all_updates(session_id) if r["timestamp"] > timestamp]
+
+    def get_latest_update(self, session_id: str) -> Optional[dict]:
+        ups = self.get_all_updates(session_id)
+        return ups[-1] if ups else None
+
+
+InMemoryStatsStorage = StatsStorage
+
+
+class FileStatsStorage(StatsStorage):
+    """Durable JSON-lines storage (ui/storage FileStatsStorage capability):
+    every record appends to ``path``; existing records load on open."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    r = json.loads(line)
+                    (self._static if r.get("kind") == "static" else self._updates).append(r)
+        self._file = open(path, "a")
+
+    def _append(self, record: dict) -> None:
+        self._file.write(json.dumps(record, default=float) + "\n")
+        self._file.flush()
+
+    def put_static_info(self, record: dict) -> None:
+        record = dict(record, kind="static", timestamp=record.get("timestamp", time.time()))
+        with self._lock:
+            self._static.append(record)
+            self._append(record)
+        self._notify(record)
+
+    def put_update(self, record: dict) -> None:
+        record = dict(record, kind="update", timestamp=record.get("timestamp", time.time()))
+        with self._lock:
+            self._updates.append(record)
+            self._append(record)
+        self._notify(record)
+
+    def close(self) -> None:
+        self._file.close()
