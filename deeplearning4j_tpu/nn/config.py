@@ -119,6 +119,14 @@ class LayerConfig:
     l2: float = 0.0
     updater: Optional[dict] = None  # per-layer updater override (see training/updaters.py)
     trainable: bool = True          # False == FrozenLayer wrapper in the reference
+    # parameter constraints applied post-update inside the jitted step
+    # (nn/conf/constraint/ parity; see nn/constraints.py for spec format)
+    constraints: Any = ()
+    # train-time weight noise (nn/conf/weightnoise/ parity):
+    #   {"type": "dropconnect", "p": 0.95}  p = weight RETAIN probability,
+    #       inverted scaling (DropConnect.java applies DropOutInverted)
+    #   {"type": "gaussian", "stddev": 0.01, "additive": true}
+    weight_noise: Optional[dict] = None
 
     # -- registry / serde --------------------------------------------------
     _type_name = "base"
@@ -179,6 +187,41 @@ class LayerConfig:
         keep = 1.0 - self.dropout
         mask = jax.random.bernoulli(rng, keep, x.shape)
         return jnp.where(mask, x / keep, 0.0)
+
+    def maybe_weight_noise(self, params, train: bool, rng):
+        """Perturb weight-class params at train time per ``weight_noise``
+        (DropConnect.java / WeightNoise.java). Pure function of (params,
+        rng): fused into the jitted step, identity at inference."""
+        wn = self.weight_noise
+        if not wn or not train or not params:
+            return params
+        if rng is None:
+            raise ValueError(f"Layer {self.name or self._type_name}: weight noise requires an rng key")
+        kind = wn.get("type", "dropconnect")
+        bias_names = self.BIAS_PARAM_NAMES
+
+        def visit(p, key):
+            out = {}
+            for i, (name, v) in enumerate(sorted(p.items())):
+                k = jax.random.fold_in(key, i)
+                if isinstance(v, dict):
+                    out[name] = visit(v, k)
+                    continue
+                if name in bias_names and not wn.get("apply_to_bias", False):
+                    out[name] = v
+                    continue
+                if kind == "dropconnect":
+                    keep = float(wn.get("p", 0.5))
+                    mask = jax.random.bernoulli(k, keep, v.shape)
+                    out[name] = jnp.where(mask, v / keep, 0.0)
+                elif kind == "gaussian":
+                    noise = float(wn.get("stddev", 0.01)) * jax.random.normal(k, v.shape, v.dtype)
+                    out[name] = v + noise if wn.get("additive", True) else v * (1.0 + noise)
+                else:
+                    raise ValueError(f"unknown weight_noise type {kind!r}")
+            return out
+
+        return visit(params, rng)
 
     # Param names treated as bias-class (excluded from l1/l2 by default, as in
     # the reference where regularization applies to weight-class params only;

@@ -698,7 +698,12 @@ class ComputationGraph:
                     x, _ = v.pre.apply({}, {}, x, train=train, rng=None, mask=m)
                     m = v.pre.propagate_mask(m, it)
                     it = v.input_types[0]
-                y, ns = v.config.apply(params[name], state[name], x,
+                p_v = params[name]
+                if train and v.config.weight_noise and rng is not None:
+                    p_v = v.config.maybe_weight_noise(
+                        p_v, train, jax.random.fold_in(rng, 0x5EED)
+                    )
+                y, ns = v.config.apply(p_v, state[name], x,
                                        train=train, rng=rng, mask=m)
                 mask_acts[name] = v.config.propagate_mask(m, it)
             else:
@@ -763,9 +768,14 @@ class ComputationGraph:
                         gn, getattr(cfg, "gradient_normalization_threshold", 1.0), g
                     )
                 upd, ns = updaters[name].update(g, opt_state[name], params[name], it)
-                new_params[name] = jax.tree_util.tree_map(
+                p_new = jax.tree_util.tree_map(
                     lambda p, d: p - d, params[name], upd
                 )
+                if getattr(cfg, "constraints", None):
+                    from deeplearning4j_tpu.nn.constraints import apply_constraints
+
+                    p_new = apply_constraints(cfg, p_new)
+                new_params[name] = p_new
                 new_opt[name] = ns
             return new_params, new_opt, new_state, loss
 

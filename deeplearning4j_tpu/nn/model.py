@@ -58,6 +58,11 @@ class MultiLayerConfiguration:
     backprop_type: str = "standard"        # "standard" | "tbptt"
     tbptt_fwd_length: int = 20
     tbptt_back_length: int = 20
+    # OptimizationAlgorithm dispatch (optimize/Solver.java:50-80):
+    # "stochastic_gradient_descent" (the jitted step) or one of the
+    # deterministic solvers in train/solvers.py
+    optimization_algo: str = "stochastic_gradient_descent"
+    solver_iterations: int = 5             # solver steps per batch (non-SGD)
 
     def __post_init__(self):
         self.layers = tuple(self.layers)
@@ -75,6 +80,8 @@ class MultiLayerConfiguration:
             "backprop_type": self.backprop_type,
             "tbptt_fwd_length": self.tbptt_fwd_length,
             "tbptt_back_length": self.tbptt_back_length,
+            "optimization_algo": self.optimization_algo,
+            "solver_iterations": self.solver_iterations,
         }
 
     def to_json(self, **kw) -> str:
@@ -91,6 +98,8 @@ class MultiLayerConfiguration:
             backprop_type=d.get("backprop_type", "standard"),
             tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
             tbptt_back_length=d.get("tbptt_back_length", 20),
+            optimization_algo=d.get("optimization_algo", "stochastic_gradient_descent"),
+            solver_iterations=d.get("solver_iterations", 5),
         )
 
     @staticmethod
@@ -261,13 +270,17 @@ class MultiLayerNetwork:
         for i in range(n):
             layer = self.layers[i]
             lrng = rngs[i] if rngs is not None else None
+            p_i = params[i]
+            if train and layer.weight_noise and lrng is not None:
+                # separate stream from input dropout on the same layer
+                p_i = layer.maybe_weight_noise(p_i, train, jax.random.fold_in(lrng, 0x5EED))
             if new_carries is not None and self._carry_flags[i]:
                 a2 = layer.maybe_dropout_input(a, train, lrng)
-                a, c = layer.apply_seq(params[i], a2, new_carries[i], mask)
+                a, c = layer.apply_seq(p_i, a2, new_carries[i], mask)
                 new_carries[i] = c
                 ns = state[i]
             else:
-                a, ns = layer.apply(params[i], state[i], a, train=train, rng=lrng, mask=mask)
+                a, ns = layer.apply(p_i, state[i], a, train=train, rng=lrng, mask=mask)
             new_state[i] = ns
             mask = layer.propagate_mask(mask, self.layer_input_types[i])
             if collect:
@@ -335,7 +348,13 @@ class MultiLayerNetwork:
                         gn, getattr(layer, "gradient_normalization_threshold", 1.0), g
                     )
                 upd, new_s = u.update(g, opt_state[i], params[i], it)
-                new_params.append(jax.tree_util.tree_map(lambda p, d: p - d, params[i], upd))
+                p_new = jax.tree_util.tree_map(lambda p, d: p - d, params[i], upd)
+                if getattr(layer, "constraints", None):
+                    # post-update projection, fused into the same executable
+                    from deeplearning4j_tpu.nn.constraints import apply_constraints
+
+                    p_new = apply_constraints(layer, p_new)
+                new_params.append(p_new)
                 new_opt.append(new_s)
             return tuple(new_params), tuple(new_opt), new_state, new_carries, loss
 
@@ -367,7 +386,11 @@ class MultiLayerNetwork:
                 l.on_epoch_start(self, self.epoch)
             source = data() if callable(data) else data
             for x, y, fm, lm in _iter_batches(source, batch_size):
-                if tbptt and np.ndim(x) == 3:
+                if self.conf.optimization_algo not in (
+                    "stochastic_gradient_descent", "sgd"
+                ):
+                    score = self._fit_solver(x, y, fm, lm)
+                elif tbptt and np.ndim(x) == 3:
                     score = self._fit_tbptt(x, y, fm, lm)
                 else:
                     score = self._fit_batch(x, y, fm, lm)
@@ -395,6 +418,16 @@ class MultiLayerNetwork:
             jnp.asarray(self.iteration, jnp.int32), self._next_rng(),
             x, y, fm, lm, (),
         )
+        self.iteration += 1
+        return loss
+
+    def _fit_solver(self, x, y, fm, lm):
+        """Non-SGD OptimizationAlgorithm path (Solver.java dispatch): run
+        conf.solver_iterations deterministic solver steps on this batch."""
+        from deeplearning4j_tpu.train.solvers import Solver
+
+        solver = Solver(self, self.conf.optimization_algo)
+        loss = solver.optimize((x, y, fm, lm), iterations=self.conf.solver_iterations)
         self.iteration += 1
         return loss
 
