@@ -125,8 +125,11 @@ class EmnistDataFetcher(MnistDataFetcher):
             self.synthetic = False
         else:
             n = int(os.environ.get("DL4J_TPU_SYNTH_N", 10000))
+            # stable per-split offset (hash() is randomized per process by
+            # PYTHONHASHSEED and would break the deterministic surrogate)
+            split_seed = sum(ord(c) for c in split) % 1000
             self.images, self.labels = _synthetic_images(
-                n, self.N_CLASSES, 28, 28, 1, seed + hash(split) % 1000
+                n, self.N_CLASSES, 28, 28, 1, seed + split_seed
             )
             self.synthetic = True
 
@@ -209,20 +212,58 @@ class CifarDataSetIterator(ListDataSetIterator):
 
 class TinyImageNetDataSetIterator(ListDataSetIterator):
     """TinyImageNet 64x64x3, 200 classes (TinyImageNetFetcher.java); images
-    from cache-dir folder layout, else synthetic."""
+    load from the cache-dir folder layout
+    (``tiny-imagenet-200/train/<wnid>/images/*.JPEG``) when present and PIL
+    is importable, else a deterministic synthetic surrogate."""
 
     N_CLASSES = 200
 
     def __init__(self, batch_size: int, train: bool = True, seed: int = 12345,
                  num_examples: Optional[int] = None):
-        n = int(os.environ.get("DL4J_TPU_SYNTH_N", 2000))
-        x, y = _synthetic_images(n, self.N_CLASSES, 64, 64, 3, seed + 7)
-        self.synthetic = True
+        loaded = self._try_load_folder(train, num_examples)
+        if loaded is not None:
+            x, y = loaded
+            self.synthetic = False
+        else:
+            n = int(os.environ.get("DL4J_TPU_SYNTH_N", 2000))
+            x, y = _synthetic_images(n, self.N_CLASSES, 64, 64, 3, seed + 7)
+            self.synthetic = True
         ds = DataSet(x.astype(np.float32) / 255.0,
                      np.eye(self.N_CLASSES, dtype=np.float32)[y])
         if num_examples is not None:
             ds, _ = ds.split_test_and_train(num_examples)
         super().__init__(ds, batch_size)
+
+    def _try_load_folder(self, train: bool, limit: Optional[int]):
+        root = os.path.join(cache_dir(), "tiny-imagenet-200")
+        split_dir = os.path.join(root, "train" if train else "val")
+        if not os.path.isdir(split_dir):
+            return None
+        try:
+            from PIL import Image
+        except ImportError:
+            return None
+        wnids_file = os.path.join(root, "wnids.txt")
+        if not os.path.exists(wnids_file):
+            return None
+        wnids = [w.strip() for w in open(wnids_file) if w.strip()]
+        cls_of = {w: i for i, w in enumerate(wnids)}
+        xs, ys = [], []
+        for wnid in wnids:
+            img_dir = os.path.join(split_dir, wnid, "images")
+            if not os.path.isdir(img_dir):
+                continue
+            for fn in sorted(os.listdir(img_dir)):
+                img = Image.open(os.path.join(img_dir, fn)).convert("RGB")
+                xs.append(np.asarray(img, np.uint8))
+                ys.append(cls_of[wnid])
+                if limit is not None and len(xs) >= limit:
+                    break
+            if limit is not None and len(xs) >= limit:
+                break
+        if not xs:
+            return None
+        return np.stack(xs), np.asarray(ys, np.int64)
 
 
 def uci_synthetic_control(n_per_class: int = 100, timesteps: int = 60,

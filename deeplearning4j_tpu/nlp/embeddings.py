@@ -154,6 +154,25 @@ class _PairGenerator:
                     if j != i:
                         yield center, idx[j]
 
+    def generate_windows(self, idx_seqs: Iterable[np.ndarray]):
+        """CBOW windows (CBOW.java semantics): for each center position,
+        yield (center, [context ids]) with the full dynamic window — the
+        window AVERAGE predicts the center, not reversed skip-gram pairs."""
+        for idx in idx_seqs:
+            if len(idx) < 2:
+                continue
+            keep = self.rs.rand(len(idx)) < self.keep[idx]
+            idx = idx[keep]
+            if len(idx) < 2:
+                continue
+            b = self.rs.randint(1, self.window + 1, len(idx))
+            for i, center in enumerate(idx):
+                lo = max(0, i - b[i])
+                hi = min(len(idx), i + b[i] + 1)
+                ctx = [int(idx[j]) for j in range(lo, hi) if j != i]
+                if ctx:
+                    yield int(center), ctx
+
 
 def _batched(gen, batch_size: int):
     buf_c, buf_t = [], []
@@ -165,6 +184,30 @@ def _batched(gen, batch_size: int):
             buf_c, buf_t = [], []
     if buf_c:
         yield np.asarray(buf_c, np.int32), np.asarray(buf_t, np.int32)
+
+
+def _batched_windows(gen, batch_size: int, max_width: int):
+    """Batch (center, [contexts]) into padded [B,W] arrays + win_mask."""
+
+    def flush(centers, ctxs):
+        B = len(centers)
+        win = np.zeros((B, max_width), np.int32)
+        mask = np.zeros((B, max_width), np.float32)
+        for r, ctx in enumerate(ctxs):
+            L = min(len(ctx), max_width)
+            win[r, :L] = ctx[:L]
+            mask[r, :L] = 1.0
+        return np.asarray(centers, np.int32), win, mask
+
+    centers, ctxs = [], []
+    for c, ctx in gen:
+        centers.append(c)
+        ctxs.append(ctx)
+        if len(centers) == batch_size:
+            yield flush(centers, ctxs)
+            centers, ctxs = [], []
+    if centers:
+        yield flush(centers, ctxs)
 
 
 # ---------------------------------------------------------------------------
@@ -266,8 +309,25 @@ class SequenceVectors:
         )
         seen = 0
         for _ in range(self.epochs):
-            gen = _PairGenerator(self.window, keep, self._rs).generate(idx_seqs)
-            for centers, contexts in _batched(gen, self.batch_size):
+            pg = _PairGenerator(self.window, keep, self._rs)
+            if self.elements_learning == "cbow" and not self.use_hs:
+                # true CBOW (CBOW.java): the window AVERAGE predicts the
+                # center — padded [B, 2*window] windows with win_mask
+                step = self._jit_step("cbow_ns")
+                for centers, win, wmask in _batched_windows(
+                    pg.generate_windows(idx_seqs), self.batch_size, 2 * self.window
+                ):
+                    frac = min(seen / total_pairs_est, 1.0)
+                    lr = max(self.lr * (1.0 - frac), self.min_lr)
+                    seen += len(centers)
+                    negs = self._draw_negatives(table, (len(centers), self.negative))
+                    self.params, _ = step(
+                        self.params, jnp.asarray(win), jnp.asarray(wmask),
+                        jnp.asarray(centers), jnp.asarray(negs),
+                        jnp.asarray(lr, jnp.float32),
+                    )
+                continue
+            for centers, contexts in _batched(pg.generate(idx_seqs), self.batch_size):
                 frac = min(seen / total_pairs_est, 1.0)
                 lr = max(self.lr * (1.0 - frac), self.min_lr)
                 seen += len(centers)
@@ -276,17 +336,6 @@ class SequenceVectors:
                     self.params, _ = step(
                         self.params, jnp.asarray(centers),
                         codes_j[contexts], points_j[contexts], hmask_j[contexts],
-                        jnp.asarray(lr, jnp.float32),
-                    )
-                elif self.elements_learning == "cbow":
-                    # regroup SG pairs into CBOW windows: target=center,
-                    # window=all contexts of that center within the batch
-                    step = self._jit_step("cbow_ns")
-                    negs = self._draw_negatives(table, (len(centers), self.negative))
-                    self.params, _ = step(
-                        self.params, jnp.asarray(contexts[:, None]),
-                        jnp.ones((len(contexts), 1), jnp.float32),
-                        jnp.asarray(centers), jnp.asarray(negs),
                         jnp.asarray(lr, jnp.float32),
                     )
                 else:
