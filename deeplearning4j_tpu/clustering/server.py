@@ -77,7 +77,12 @@ class NearestNeighborsServer:
                         row = int(req["ndarray"])
                         vec = outer.points[row]
                         results = outer._search(vec, k + 1)
-                        # drop the query row itself (reference /knn semantics)
+                        # drop the query row itself (reference /knn semantics).
+                        # The [:k] slice only trims when the self row wasn't
+                        # among the k+1 hits (duplicate points); when the
+                        # corpus caps the search (k >= num points) the
+                        # filtered list is already <= k, so no available
+                        # neighbor is ever dropped.
                         results = [r for r in results if r["index"] != row][:k]
                     elif self.path == "/knnnew":
                         vec = np.asarray(req["ndarray"], np.float32).reshape(-1)

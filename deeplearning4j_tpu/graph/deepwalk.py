@@ -135,7 +135,25 @@ class DeepWalk:
             if self.params is None:
                 raise RuntimeError("call initialize(graph) before fit(iterator)")
             n_vertices = self.syn0.shape[0]
-            make_it = lambda ep: graph_or_iterator
+            # Multi-epoch support: walk iterators expose reset() (and need
+            # it — RandomWalkIterator.__iter__ shares cursor state, so it
+            # yields nothing on a second pass); plain sequences (lists of
+            # walks) re-iterate naturally; a bare single-use iterator
+            # (iter(x) is x, e.g. a generator) would silently train on
+            # nothing after epoch 1, so reject it up front.
+            has_reset = hasattr(graph_or_iterator, "reset")
+            if (epochs > 1 and not has_reset
+                    and iter(graph_or_iterator) is graph_or_iterator):
+                raise ValueError(
+                    "epochs>1 with a single-use iterator would silently train "
+                    "on nothing after epoch 1; pass a Graph, a sequence of "
+                    "walks, or an iterator with reset()"
+                )
+
+            def make_it(ep):
+                if ep > 0 and has_reset:
+                    graph_or_iterator.reset()
+                return graph_or_iterator
         codes = jnp.asarray(self.huffman.codes)
         points = jnp.asarray(self.huffman.points)
         hmask = jnp.asarray(self.huffman.mask)

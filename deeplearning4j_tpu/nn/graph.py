@@ -662,12 +662,16 @@ class ComputationGraph:
     # -- forward -----------------------------------------------------------
     def _forward(self, params, state, inputs: Dict[str, jax.Array], *, train, rngs,
                  masks: Optional[Dict[str, Any]] = None, stop_at: Optional[set] = None,
-                 collect: bool = False):
+                 collect: bool = False, ex_weight=None):
         """Walk topo order. Returns (acts, new_state, mask_acts).
 
         ``stop_at``: vertex names whose activation should be the PRE-output
         value for loss heads — loss vertices are applied outside (score needs
         the pre-activation input, mirroring MLN's upto=n-1 walk).
+        ``ex_weight``: per-example [B] validity weight consumed only by layer
+        vertices declaring CONSUMES_EXAMPLE_WEIGHT (BatchNorm excludes
+        zero-weighted ParallelWrapper padding rows from batch statistics —
+        same channel as MultiLayerNetwork._forward).
         """
         acts: Dict[str, jax.Array] = dict(inputs)
         mask_acts: Dict[str, Any] = dict(masks or {})
@@ -703,8 +707,12 @@ class ComputationGraph:
                     p_v = v.config.maybe_weight_noise(
                         p_v, train, jax.random.fold_in(rng, 0x5EED)
                     )
-                y, ns = v.config.apply(p_v, state[name], x,
-                                       train=train, rng=rng, mask=m)
+                if ex_weight is not None and getattr(v.config, "CONSUMES_EXAMPLE_WEIGHT", False):
+                    y, ns = v.config.apply(p_v, state[name], x, train=train,
+                                           rng=rng, mask=m, ex_weight=ex_weight)
+                else:
+                    y, ns = v.config.apply(p_v, state[name], x,
+                                           train=train, rng=rng, mask=m)
                 mask_acts[name] = v.config.propagate_mask(m, it)
             else:
                 # mask_input: vertex reads the mask of a NAMED input instead
@@ -720,10 +728,12 @@ class ComputationGraph:
         return acts, new_state, mask_acts
 
     # -- loss --------------------------------------------------------------
-    def _loss(self, params, state, inputs, labels, fmasks, lmasks, rngs, train=True):
+    def _loss(self, params, state, inputs, labels, fmasks, lmasks, rngs, train=True,
+              ex_weight=None):
         stop = set(self._loss_vertices)
         acts, new_state, mask_acts = self._forward(
-            params, state, inputs, train=train, rngs=rngs, masks=fmasks, stop_at=stop
+            params, state, inputs, train=train, rngs=rngs, masks=fmasks, stop_at=stop,
+            ex_weight=ex_weight,
         )
         total = jnp.asarray(0.0, jnp.float32)
         for i, oname in enumerate(self.conf.outputs):
@@ -747,11 +757,13 @@ class ComputationGraph:
         order = self.topo_order
         updaters = self._updaters
 
-        def step(params, opt_state, state, it, rng, inputs, labels, fmasks, lmasks):
+        def step(params, opt_state, state, it, rng, inputs, labels, fmasks, lmasks,
+                 ex_weight=None):
             rngs = list(jax.random.split(rng, len(order)))
 
             def loss_fn(p):
-                return self._loss(p, state, inputs, labels, fmasks, lmasks, rngs)
+                return self._loss(p, state, inputs, labels, fmasks, lmasks, rngs,
+                                  ex_weight=ex_weight)
 
             (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             new_params, new_opt = {}, {}
@@ -889,8 +901,10 @@ class ComputationGraph:
         for b in data:
             yield self._as_multi_batch(b)
 
-    def fit_batch(self, batch):
-        """One jitted step on one (already normalized or raw) batch."""
+    def fit_batch(self, batch, ew=None):
+        """One jitted step on one (already normalized or raw) batch.
+        ``ew``: optional per-example validity weight (ParallelWrapper
+        padding) consumed by batch-coupled layer vertices — see _forward."""
         if isinstance(batch, tuple) and len(batch) == 4 and isinstance(batch[0], tuple) \
                 and all(x is None or isinstance(x, (jax.Array, np.ndarray))
                         for x in batch[0]):
@@ -903,6 +917,7 @@ class ComputationGraph:
             self.params, self.opt_state, self.state,
             jnp.asarray(self.iteration, jnp.int32), self._next_rng(),
             self._input_dict(f), l, self._mask_dict(fm), lm,
+            ex_weight=jnp.asarray(ew, self.dtype) if ew is not None else None,
         )
         self.iteration += 1
         return loss

@@ -30,6 +30,8 @@ class BatchNorm(LayerConfig):
     running stats EMA), eps=1e-5, lockGammaBeta=False.
     """
 
+    CONSUMES_EXAMPLE_WEIGHT = True  # batch stats must exclude padded rows
+
     decay: float = 0.9
     eps: float = 1e-5
     use_gamma_beta: bool = True   # lockGammaBeta=True in DL4J means fixed 1/0
@@ -55,11 +57,25 @@ class BatchNorm(LayerConfig):
             "var": jnp.ones((n,), jnp.float32),
         }
 
-    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None,
+              ex_weight=None):
         axes = tuple(range(x.ndim - 1))  # all but channel/feature axis
         if train:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            if ex_weight is not None:
+                # Example-weighted statistics: rows with weight 0 (the
+                # ParallelWrapper padding rows) contribute nothing to
+                # mean/var, so the sharded padded step reproduces the
+                # unpadded single-device statistics EXACTLY.
+                w = ex_weight.reshape((x.shape[0],) + (1,) * (x.ndim - 1)).astype(x.dtype)
+                spatial = 1
+                for d in x.shape[1:-1]:
+                    spatial *= d
+                denom = jnp.maximum(jnp.sum(w) * spatial, 1.0)
+                mean = jnp.sum(x * w, axis=axes) / denom
+                var = jnp.sum(w * (x - mean) ** 2, axis=axes) / denom
+            else:
+                mean = jnp.mean(x, axis=axes)
+                var = jnp.var(x, axis=axes)
             new_state = {
                 "mean": self.decay * state["mean"] + (1.0 - self.decay) * mean,
                 "var": self.decay * state["var"] + (1.0 - self.decay) * var,

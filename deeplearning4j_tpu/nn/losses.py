@@ -233,9 +233,14 @@ def average_score(
         denom = jnp.maximum(jnp.sum(mask), 1.0)
         return jnp.sum(scores) / denom
     if mask is not None:
-        # Per-example mask: average over UNMASKED examples only, so a
-        # zero-weighted (padded) row neither contributes loss nor inflates
-        # the denominator (exactness of ParallelWrapper uneven batches).
-        denom = jnp.maximum(jnp.sum(mask.reshape(scores.shape)), 1.0)
-        return jnp.sum(scores) / denom
+        # Per-example mask: reference parity — BaseOutputLayer.computeScore
+        # divides by the FULL minibatch size even when a label mask is
+        # present (score /= getInputMiniBatchSize()), so a user-supplied
+        # example mask zeroes contributions without shrinking the
+        # denominator. ParallelWrapper's internal padding masks recover
+        # exact sum/n semantics by pre-scaling the mask by B_pad/n (see
+        # parallel/wrapper.py _padded_lmask) — this branch and the
+        # sum/sum(mask) branch above are both compatible with that scaling
+        # (the latter is scale-invariant).
+        return jnp.sum(scores) / scores.shape[0]
     return jnp.mean(scores)

@@ -258,9 +258,11 @@ class MultiLayerNetwork:
 
     # -- forward -----------------------------------------------------------
     def _forward(self, params, state, x, *, train, rngs, fmask=None, carries=None,
-                 upto: Optional[int] = None, collect=False):
+                 upto: Optional[int] = None, collect=False, ex_weight=None):
         """Walk the layer stack. Returns (act, new_state, new_carries, mask,
-        activations_list)."""
+        activations_list). ``ex_weight`` is a per-example [B] validity weight
+        consumed only by layers that declare CONSUMES_EXAMPLE_WEIGHT
+        (BatchNorm excludes zero-weighted padding rows from batch stats)."""
         n = len(self.layers) if upto is None else upto
         acts_list = []
         new_state = list(state)
@@ -279,6 +281,9 @@ class MultiLayerNetwork:
                 a, c = layer.apply_seq(p_i, a2, new_carries[i], mask)
                 new_carries[i] = c
                 ns = state[i]
+            elif ex_weight is not None and getattr(layer, "CONSUMES_EXAMPLE_WEIGHT", False):
+                a, ns = layer.apply(p_i, state[i], a, train=train, rng=lrng,
+                                    mask=mask, ex_weight=ex_weight)
             else:
                 a, ns = layer.apply(p_i, state[i], a, train=train, rng=lrng, mask=mask)
             new_state[i] = ns
@@ -304,11 +309,12 @@ class MultiLayerNetwork:
         return k
 
     # -- loss --------------------------------------------------------------
-    def _loss(self, params, state, x, y, fmask, lmask, rngs, carries=None, train=True):
+    def _loss(self, params, state, x, y, fmask, lmask, rngs, carries=None, train=True,
+              ex_weight=None):
         """Average score incl. L1/L2 penalties; returns (loss, (new_state, carries))."""
         a, new_state, new_carries, prop_mask, _ = self._forward(
             params, state, x, train=train, rngs=rngs, fmask=fmask,
-            carries=carries, upto=len(self.layers) - 1,
+            carries=carries, upto=len(self.layers) - 1, ex_weight=ex_weight,
         )
         out_layer = self.layers[-1]
         out_mask = lmask if lmask is not None else prop_mask
@@ -323,12 +329,14 @@ class MultiLayerNetwork:
         updaters = self._updaters
         layers = self.layers
 
-        def step(params, opt_state, state, it, rng, x, y, fmask, lmask, carries):
+        def step(params, opt_state, state, it, rng, x, y, fmask, lmask, carries,
+                 ex_weight=None):
             rngs = list(jax.random.split(rng, len(layers)))
 
             def loss_fn(p):
                 return self._loss(p, state, x, y, fmask, lmask, rngs,
-                                  carries if with_carries else None)
+                                  carries if with_carries else None,
+                                  ex_weight=ex_weight)
 
             (loss, (new_state, new_carries)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
@@ -405,9 +413,11 @@ class MultiLayerNetwork:
             self.epoch += 1
         return self
 
-    def _fit_batch(self, x, y, fm, lm):
+    def _fit_batch(self, x, y, fm, lm, ew=None):
         """One step. Returns the loss as a DEVICE scalar — callers decide
-        whether to sync (fit() only syncs when listeners are attached)."""
+        whether to sync (fit() only syncs when listeners are attached).
+        ``ew``: optional per-example validity weight (ParallelWrapper padding)
+        consumed by batch-coupled layers — see _forward."""
         step = self._get_step_fn(False)
         x = _cast_input(x, self.dtype)
         y = jnp.asarray(y, self.dtype) if y is not None else None
@@ -417,16 +427,23 @@ class MultiLayerNetwork:
             self.params, self.opt_state, self.state,
             jnp.asarray(self.iteration, jnp.int32), self._next_rng(),
             x, y, fm, lm, (),
+            ex_weight=jnp.asarray(ew, self.dtype) if ew is not None else None,
         )
         self.iteration += 1
         return loss
 
     def _fit_solver(self, x, y, fm, lm):
         """Non-SGD OptimizationAlgorithm path (Solver.java dispatch): run
-        conf.solver_iterations deterministic solver steps on this batch."""
+        conf.solver_iterations deterministic solver steps on this batch.
+        The Solver (and its jitted value_and_grad) is cached on the model so
+        successive batches/epochs reuse one compiled executable per batch
+        shape instead of retracing (round-2 advisor finding)."""
         from deeplearning4j_tpu.train.solvers import Solver
 
-        solver = Solver(self, self.conf.optimization_algo)
+        solver = getattr(self, "_solver", None)
+        if solver is None or solver.algorithm != self.conf.optimization_algo:
+            solver = Solver(self, self.conf.optimization_algo)
+            self._solver = solver
         loss = solver.optimize((x, y, fm, lm), iterations=self.conf.solver_iterations)
         self.iteration += 1
         return loss

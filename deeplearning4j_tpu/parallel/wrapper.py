@@ -84,22 +84,29 @@ class ParallelWrapper:
     def _padded_lmask(self, y, lm, n):
         """Label mask zero-weighting padded rows [n:] so the jitted step's
         loss averages over the n REAL examples only (exact equivalence with
-        the unpadded single-device fit; the loss denominator counts unmasked
-        entries — see losses.average_score).
+        the unpadded single-device fit).
+
+        ``average_score`` keeps reference parity for per-example masks
+        (divide by the full minibatch size B, BaseOutputLayer.computeScore
+        semantics), so a 0/1 validity mask alone would yield sum_real/B_pad
+        instead of sum_real/n. The validity mask is therefore PRE-SCALED by
+        B_pad/n: the per-example branch then gives
+        sum(scores·mask)·(B_pad/n)/B_pad = sum_real/n exactly, and the
+        rank-3 sum/sum(mask) branch is scale-invariant so it stays exact.
 
         Mask shape follows the label rank's masking convention: a user mask
-        is multiplied by row validity; absent one, rank-2/3 labels get a
-        per-example [B] weight (which keeps the unmasked sum/B denominator
-        — a [B,T] mask would flip average_score into its per-timestep
-        sum/sum(mask) branch and rescale gradients by 1/T), and rank-4
-        (CnnLossLayer) labels get the per-pixel [B,H,W] mask its score()
-        flattens."""
+        is multiplied by the scaled row validity; absent one, rank-2/3
+        labels get a per-example [B] weight (a [B,T] mask would flip
+        average_score into its per-timestep sum/sum(mask) branch and
+        rescale gradients by 1/T), and rank-4 (CnnLossLayer) labels get the
+        per-pixel [B,H,W] mask its score() flattens (the flattened
+        denominator B_pad·H·W needs the same B_pad/n correction)."""
         y = np.asarray(y)
         total = len(y)
         if total == n and lm is None:
             return lm
         valid = np.zeros(total, np.float32)
-        valid[:n] = 1.0
+        valid[:n] = float(total) / float(n)
         if lm is not None:
             lm = np.asarray(lm, np.float32)
             return lm * valid.reshape([total] + [1] * (lm.ndim - 1))
@@ -125,11 +132,17 @@ class ParallelWrapper:
             for batch in _iter_batches(source, batch_size):
                 # pad so the batch shards exactly (the reference round-robins
                 # whole DataSets to workers; here the split must be even),
-                # then zero-weight the padded rows in the loss
+                # then zero-weight the padded rows in the loss; ew excludes
+                # them from batch-coupled statistics (BatchNorm)
                 (x, y, fm, lm), n = self._pad_to_shardable(batch)
                 lm = self._padded_lmask(y, lm, n)
+                ew = None
+                if len(x) != n:
+                    ew = np.zeros(len(x), np.float32)
+                    ew[:n] = 1.0
                 score = model._fit_batch(
-                    self._shard(x), self._shard(y), self._shard(fm), self._shard(lm)
+                    self._shard(x), self._shard(y), self._shard(fm),
+                    self._shard(lm), ew=self._shard(ew),
                 )
                 if model.listeners:
                     score = float(score)
@@ -165,8 +178,16 @@ class ParallelWrapper:
                     )
                     if all(m is None for m in lm):
                         lm = None
+                ew = None
+                total = len(f[0])
+                if total != n:
+                    # exclude padded rows from batch-coupled statistics
+                    # (BatchNorm vertices) — same channel as the MLN path
+                    ew = np.zeros(total, np.float32)
+                    ew[:n] = 1.0
                 score = model.fit_batch(
-                    (shard_t(f), shard_t(lbl), shard_t(fm), shard_t(lm))
+                    (shard_t(f), shard_t(lbl), shard_t(fm), shard_t(lm)),
+                    ew=self._shard(ew),
                 )
                 if model.listeners:
                     score = float(score)

@@ -66,21 +66,31 @@ class Solver:
         self.line_search = line_search or BackTrackLineSearch()
         self._vg = None
         self._f = None
+        self._jf = None
+        self._jvg = None
 
     # -- jitted loss over the flat vector ---------------------------------
     def _build(self, x, y, fm, lm):
+        """Bind the batch to the (cached) jitted executables. The batch and
+        mutable state are jit ARGUMENTS, not closure captures, so reusing
+        the Solver across batches/epochs hits the jit cache instead of
+        retracing (round-2 advisor finding)."""
         model = self.model
         flat0, unravel = ravel_pytree(model.params)
-        rngs = None  # deterministic objective: no dropout/noise streams
+        if self._jvg is None:
+            rngs = None  # deterministic objective: no dropout/noise streams
+
+            def loss_flat(flat, state, xb, yb, fmb, lmb):
+                params = unravel(flat)
+                loss, _ = model._loss(params, state, xb, yb, fmb, lmb, rngs,
+                                      train=False)
+                return loss
+
+            self._jf = jax.jit(loss_flat)
+            self._jvg = jax.jit(jax.value_and_grad(loss_flat))
         state = model.state
-
-        def loss_flat(flat):
-            params = unravel(flat)
-            loss, _ = model._loss(params, state, x, y, fm, lm, rngs, train=False)
-            return loss
-
-        self._f = jax.jit(loss_flat)
-        self._vg = jax.jit(jax.value_and_grad(loss_flat))
+        self._f = lambda flat: self._jf(flat, state, x, y, fm, lm)
+        self._vg = lambda flat: self._jvg(flat, state, x, y, fm, lm)
         return flat0, unravel
 
     def optimize(self, data, iterations: int = 100, tolerance: float = 1e-6) -> float:
