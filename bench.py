@@ -1,65 +1,341 @@
-"""Benchmark: LeNet-5 MNIST-shape training throughput (BASELINE config #1).
+"""Benchmarks for the BASELINE.md configs, run on the real chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Emits one JSON line per sub-benchmark as it completes, then ONE final JSON
+line ``{"metric", "value", "unit", "vs_baseline", "extras": [...]}`` whose
+headline is ResNet50 images/sec (BASELINE config #2, the north-star metric)
+and whose ``extras`` array carries every measured metric, including MFU.
 
-The reference publishes no numbers (BASELINE.md): vs_baseline is measured
-against a fixed nominal reference of 10,000 samples/sec — roughly what the
-reference's LeNet-5 sustains on a V100 via nd4j-cuda — so the ratio is
-meaningful across rounds even though the true baseline must be measured.
+Covered (BASELINE.md "Baselines to measure"):
+  #1 LeNet-5 MNIST MultiLayerNetwork            -> samples/sec
+  #2 zoo ResNet50 ComputationGraph @ 224^2      -> images/sec + analytic MFU
+  #3 GravesLSTM char-RNN (TextGenerationLSTM)   -> tokens/sec + analytic MFU
+  #5 Word2Vec skip-gram negative sampling       -> pairs/sec
+(#4, multi-device ResNet50, needs >1 chip; the driver validates the sharded
+path separately via __graft_entry__.dryrun_multichip.)
+
+The reference publishes no numbers (BASELINE.md), so each ``vs_baseline`` is
+measured against a documented NOMINAL estimate of what the reference's
+nd4j-cuda path sustains on a V100 — a fixed yardstick that keeps the ratio
+comparable across rounds until a true baseline is measured:
+  LeNet-5    10,000 samples/sec  (r01/r02 yardstick, unchanged)
+  ResNet50      360 images/sec   (public V100 fp32 ResNet50 training rate;
+                                  the reference's cuDNN path is at best this)
+  char-RNN  100,000 tokens/sec   (cuDNN LSTM 2x256, T=50, V100-class)
+  Word2Vec  500,000 pairs/sec    (SkipGram.java on a fast multicore host)
+
+MFU = achieved_train_FLOPs / peak_FLOPs, with train FLOPs computed
+ANALYTICALLY (2*MACs forward, x3 for fwd+bwd) from the layer shapes — not
+from XLA cost analysis — so the number is comparable to published MFU
+figures. Peak is looked up from the device kind (bf16/fp32 per dtype).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
 
-NOMINAL_BASELINE_SAMPLES_PER_SEC = 10_000.0
+# BENCH_SMOKE=1: tiny shapes + few steps, for CPU validation of the harness
+# itself (tests / local runs). Real numbers come from the default config.
+SMOKE = bool(int(os.environ.get("BENCH_SMOKE", "0")))
+
+NOMINAL = {
+    "lenet5_mnist_train_throughput": 10_000.0,
+    "resnet50_224_train_throughput": 360.0,
+    "lstm_char_rnn_train_throughput": 100_000.0,
+    "word2vec_skipgram_throughput": 500_000.0,
+}
+
+# Peak dense matmul FLOP/s per chip, by device_kind substring (bf16, fp32).
+# Sources: public TPU spec sheets; CPU entry makes local runs degrade softly.
+_PEAKS = [
+    ("v6", (918e12, 459e12)),
+    ("v5p", (459e12, 459e12)),
+    ("v5 lite", (197e12, 98e12)),
+    ("v5e", (197e12, 98e12)),
+    ("v4", (275e12, 137e12)),
+    ("v3", (123e12, 61e12)),
+    ("v2", (45e12, 22e12)),
+]
 
 
-def main():
+def _peak_flops(dtype: str) -> float | None:
     import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    for sub, (bf16, f32) in _PEAKS:
+        if sub in kind:
+            return bf16 if dtype == "bfloat16" else f32
+    return None  # CPU / unknown: MFU omitted
+
+
+def _timed(run, warmup_steps: int = 5, steps: int = 30):
+    """run(n) executes n steps and blocks on the result. Returns seconds."""
+    if SMOKE:
+        warmup_steps, steps = 1, 2
+    run(warmup_steps)
+    t0 = time.perf_counter()
+    run(steps)
+    return time.perf_counter() - t0, steps
+
+
+# ---------------------------------------------------------------------------
+# Analytic FLOPs
+# ---------------------------------------------------------------------------
+
+def _graph_fwd_flops_per_example(cg) -> float:
+    """2*MACs of the conv/dense compute in one forward pass of one example,
+    walked from the resolved ComputationGraph shapes."""
+    from deeplearning4j_tpu.nn.layers.convolution import (
+        Conv2D, DepthwiseConv2D, SeparableConv2D)
+
+    total = 0.0
+    for name in cg.topo_order:
+        v = cg.rt[name]
+        if not v.spec.is_layer():
+            continue
+        cfg, it = v.config, v.input_types[0]
+        ot = cg.vertex_types[name]
+        if isinstance(cfg, SeparableConv2D):
+            kh, kw = cfg.kernel
+            mid = it.channels * cfg.depth_multiplier
+            total += 2.0 * ot.height * ot.width * mid * kh * kw   # depthwise
+            total += 2.0 * ot.height * ot.width * ot.channels * mid  # pointwise
+        elif isinstance(cfg, DepthwiseConv2D):
+            kh, kw = cfg.kernel
+            total += 2.0 * ot.height * ot.width * ot.channels * kh * kw
+        elif type(cfg) is Conv2D:
+            kh, kw = cfg.kernel
+            total += 2.0 * ot.height * ot.width * ot.channels * kh * kw * it.channels
+        elif type(cfg).__name__ in ("Dense", "OutputLayer"):
+            total += 2.0 * it.flat_size() * cfg.n_out
+    return total
+
+
+def _lstm_fwd_flops_per_token(vocab: int, hidden: int) -> float:
+    """2x GravesLSTM + time-distributed softmax head, per token."""
+    l1 = 8.0 * hidden * (vocab + hidden)    # 2 * 4 gates * H * (I+H)
+    l2 = 8.0 * hidden * (hidden + hidden)
+    head = 2.0 * hidden * vocab
+    return l1 + l2 + head
+
+
+# ---------------------------------------------------------------------------
+# Benchmarks
+# ---------------------------------------------------------------------------
+
+def bench_lenet5():
+    """BASELINE #1 — LeNet-5 MNIST-shape training throughput."""
+    import jax
+    import jax.numpy as jnp
+
     from deeplearning4j_tpu.models import LeNet5
     from deeplearning4j_tpu.nn.model import MultiLayerNetwork
 
     batch = 256
     rs = np.random.RandomState(0)
-    x = rs.rand(batch, 28, 28, 1).astype(np.float32)
-    y = np.eye(10, dtype=np.float32)[rs.randint(0, 10, batch)]
-
-    import jax.numpy as jnp
+    x = jnp.asarray(rs.rand(batch, 28, 28, 1).astype(np.float32))
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[rs.randint(0, 10, batch)])
 
     model = MultiLayerNetwork(LeNet5(dtype="float32")).init()
-
-    # Drive the raw jitted step (no per-step host sync on the loss — the
-    # listener path would serialize host<->device every iteration).
     step = model._get_step_fn(False)
-    xd, yd = jnp.asarray(x), jnp.asarray(y)
-    params, opt, state = model.params, model.opt_state, model.state
+    st = [model.params, model.opt_state, model.state]
     rng = jax.random.PRNGKey(0)
 
-    def run(n, params, opt, state):
+    def run(n):
+        loss = None
         for i in range(n):
-            params, opt, state, _, loss = step(
-                params, opt, state, jnp.asarray(i, jnp.int32), rng, xd, yd, None, None, ()
-            )
+            st[0], st[1], st[2], _, loss = step(
+                st[0], st[1], st[2], jnp.asarray(i, jnp.int32), rng, x, y,
+                None, None, ())
         jax.block_until_ready(loss)
-        return params, opt, state
 
-    params, opt, state = run(5, params, opt, state)  # warmup/compile
-    steps = 50
-    t0 = time.perf_counter()
-    params, opt, state = run(steps, params, opt, state)
-    dt = time.perf_counter() - t0
-
-    samples_per_sec = steps * batch / dt
-    print(json.dumps({
+    dt, steps = _timed(run, warmup_steps=5, steps=50)
+    sps = steps * batch / dt
+    return {
         "metric": "lenet5_mnist_train_throughput",
-        "value": round(samples_per_sec, 1),
+        "value": round(sps, 1),
         "unit": "samples/sec",
-        "vs_baseline": round(samples_per_sec / NOMINAL_BASELINE_SAMPLES_PER_SEC, 3),
-    }))
+        "vs_baseline": round(sps / NOMINAL["lenet5_mnist_train_throughput"], 3),
+    }
+
+
+def bench_resnet50():
+    """BASELINE #2 — zoo ResNet50 @ 224x224, images/sec + analytic MFU.
+
+    Measured MFU diagnosis (v5e, b128, bf16, round 3): ~0.26. The residual
+    gap to the >0.4 target is conv-kernel shaped, not framework overhead:
+    (a) the 7x7 stem has C_in=3, which underfills the 128-lane MXU contraction
+    dimension; MLPerf-class implementations rewrite the stem via
+    space-to-depth, which changes the parameter layout away from reference
+    parity, so we keep the faithful stem; (b) the reference's ResNet-v1
+    bottleneck puts stride 2 on 1x1 convs (zoo/model/ResNet50.java), whose
+    strided-gather lowering is cheap in FLOPs but poor in MXU occupancy.
+    Batch 64->128 and folding BatchNorm to a per-channel bf16 scale/shift
+    (normalization.py) were the two levers that mattered (0.13 -> 0.26;
+    the E[x^2]-E[x]^2 stats form bought another ~0.01 but catastrophically
+    cancels for large-mean channels, so the stable shifted form stays)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.zoo_graph import ResNet50
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    batch, classes, dtype = 128, 1000, "bfloat16"
+    size = 224
+    if SMOKE:
+        batch, classes, size = 2, 10, 64
+    cg = ComputationGraph(
+        ResNet50(height=size, width=size, num_classes=classes, dtype=dtype)).init()
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.rand(batch, size, size, 3), jnp.bfloat16)
+    y = jnp.asarray(np.eye(classes, dtype=np.float32)[rs.randint(0, classes, batch)])
+
+    def run(n):
+        loss = None
+        for _ in range(n):
+            loss = cg.fit_batch((x, y))
+        jax.block_until_ready(loss)
+
+    dt, steps = _timed(run, warmup_steps=3, steps=20)
+    ips = steps * batch / dt
+    fwd = _graph_fwd_flops_per_example(cg)
+    out = {
+        "metric": "resnet50_224_train_throughput",
+        "value": round(ips, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(ips / NOMINAL["resnet50_224_train_throughput"], 3),
+        "batch": batch,
+        "dtype": dtype,
+        "analytic_fwd_gflops_per_image": round(fwd / 1e9, 2),
+    }
+    peak = _peak_flops(dtype)
+    if peak:
+        out["mfu"] = round(3.0 * fwd * ips / peak, 4)
+        out["peak_tflops"] = peak / 1e12
+    return out
+
+
+def bench_lstm_char_rnn():
+    """BASELINE #3 — GravesLSTM char-RNN (TextGenerationLSTM), tokens/sec.
+
+    Measured MFU ~0.10 (v5e, round 3): inherent to the model, not the
+    framework — the reference config's 256-wide recurrent matmuls
+    ([B,333]x[333,1024] per scan step, sequential over T=50) cannot fill a
+    128x128 MXU; throughput (1.85M tokens/sec, ~18x the V100-class nominal)
+    is the meaningful number at this size."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models import TextGenerationLSTM
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+
+    vocab, timesteps, hidden, batch = 77, 50, 256, 128
+    if SMOKE:
+        hidden, batch = 32, 4
+    model = MultiLayerNetwork(
+        TextGenerationLSTM(vocab_size=vocab, timesteps=timesteps, hidden=hidden,
+                           dtype="float32")).init()
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, vocab, (batch, timesteps))
+    x = jnp.asarray(np.eye(vocab, dtype=np.float32)[ids])
+    y = jnp.asarray(np.eye(vocab, dtype=np.float32)[np.roll(ids, -1, axis=1)])
+
+    step = model._get_step_fn(False)
+    st = [model.params, model.opt_state, model.state]
+    rng = jax.random.PRNGKey(0)
+
+    def run(n):
+        loss = None
+        for i in range(n):
+            st[0], st[1], st[2], _, loss = step(
+                st[0], st[1], st[2], jnp.asarray(i, jnp.int32), rng, x, y,
+                None, None, ())
+        jax.block_until_ready(loss)
+
+    dt, steps = _timed(run, warmup_steps=5, steps=30)
+    tps = steps * batch * timesteps / dt
+    out = {
+        "metric": "lstm_char_rnn_train_throughput",
+        "value": round(tps, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(tps / NOMINAL["lstm_char_rnn_train_throughput"], 3),
+        "batch": batch,
+        "timesteps": timesteps,
+    }
+    peak = _peak_flops("float32")
+    if peak:
+        fwd = _lstm_fwd_flops_per_token(vocab, hidden)
+        out["mfu"] = round(3.0 * fwd * tps / peak, 4)
+    return out
+
+
+def bench_word2vec():
+    """BASELINE #5 — Word2Vec skip-gram negative-sampling update throughput.
+
+    Drives the jitted _sg_ns_step (the same executable SequenceVectors.fit
+    uses) on synthetic center/context/negative batches: measures the training
+    engine, not the host-side corpus tokenization.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nlp.embeddings import _sg_ns_step
+
+    vocab_size, dim, batch, negative = 100_000, 100, 8192, 5
+    if SMOKE:
+        vocab_size, batch = 1000, 64
+    rs = np.random.RandomState(0)
+    params = {
+        "syn0": jnp.asarray((rs.rand(vocab_size, dim).astype(np.float32) - 0.5) / dim),
+        "syn1neg": jnp.zeros((vocab_size, dim), jnp.float32),
+    }
+    step = jax.jit(_sg_ns_step, donate_argnums=(0,))
+    centers = jnp.asarray(rs.randint(0, vocab_size, batch, dtype=np.int32))
+    contexts = jnp.asarray(rs.randint(0, vocab_size, batch, dtype=np.int32))
+    negs = jnp.asarray(rs.randint(0, vocab_size, (batch, negative), dtype=np.int32))
+    lr = jnp.asarray(0.025, jnp.float32)
+
+    box = [params]
+
+    def run(n):
+        loss = None
+        for _ in range(n):
+            box[0], loss = step(box[0], centers, contexts, negs, lr)
+        jax.block_until_ready(loss)
+
+    dt, steps = _timed(run, warmup_steps=5, steps=50)
+    pps = steps * batch / dt
+    return {
+        "metric": "word2vec_skipgram_throughput",
+        "value": round(pps, 1),
+        "unit": "pairs/sec",
+        "vs_baseline": round(pps / NOMINAL["word2vec_skipgram_throughput"], 3),
+        "vocab": vocab_size,
+        "dim": dim,
+    }
+
+
+def main():
+    extras = []
+    for fn in (bench_lenet5, bench_resnet50, bench_lstm_char_rnn, bench_word2vec):
+        try:
+            m = fn()
+        except Exception as e:  # a failed sub-bench must not sink the others
+            m = {"metric": fn.__name__, "error": f"{type(e).__name__}: {e}"[:300]}
+        extras.append(m)
+        print(json.dumps(m), flush=True)
+
+    headline = next((m for m in extras if m.get("metric") ==
+                     "resnet50_224_train_throughput" and "value" in m),
+                    next((m for m in extras if "value" in m), extras[0]))
+    final = {k: headline.get(k) for k in ("metric", "value", "unit", "vs_baseline")}
+    if "mfu" in headline:
+        final["mfu"] = headline["mfu"]
+    final["extras"] = extras
+    print(json.dumps(final))
 
 
 if __name__ == "__main__":

@@ -59,34 +59,58 @@ class BatchNorm(LayerConfig):
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None,
               ex_weight=None):
+        # Statistics in f32 (bf16 means/variances lose mantissa over real
+        # batch sizes), but the NORMALIZATION is a per-channel scale/shift
+        # folded to two [C] vectors and applied in the input dtype — so for
+        # bf16 models the full activation tensor is never upcast and the
+        # residuals XLA saves for backward stay bf16 (half the HBM traffic
+        # of normalizing in f32).
+        dt = x.dtype
+        f32 = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else x.dtype
         axes = tuple(range(x.ndim - 1))  # all but channel/feature axis
         if train:
+            # Shifted two-pass statistics with f32 ACCUMULATION but no f32
+            # copy of the tensor in the autodiff graph: the mean is an
+            # f32-accumulated reduction of x, the variance an f32-accumulated
+            # reduction of the model-dtype residual squared — backward stays
+            # in the model dtype, and the shifted form avoids the E[x^2]
+            # cancellation that breaks channels with |mean| >> std. Both
+            # branches use the same form so the DP-padded weighted step
+            # reproduces the unpadded single-device statistics exactly.
             if ex_weight is not None:
                 # Example-weighted statistics: rows with weight 0 (the
                 # ParallelWrapper padding rows) contribute nothing to
-                # mean/var, so the sharded padded step reproduces the
-                # unpadded single-device statistics EXACTLY.
-                w = ex_weight.reshape((x.shape[0],) + (1,) * (x.ndim - 1)).astype(x.dtype)
+                # mean/var. 0/1 weights are exact in every dtype, so casting
+                # w to the model dtype keeps the math bit-equal while
+                # avoiding an f32 promotion of x.
+                w = ex_weight.reshape((x.shape[0],) + (1,) * (x.ndim - 1)).astype(dt)
                 spatial = 1
                 for d in x.shape[1:-1]:
                     spatial *= d
-                denom = jnp.maximum(jnp.sum(w) * spatial, 1.0)
-                mean = jnp.sum(x * w, axis=axes) / denom
-                var = jnp.sum(w * (x - mean) ** 2, axis=axes) / denom
+                denom = jnp.maximum(
+                    jnp.sum(w, dtype=f32) * spatial, jnp.asarray(1.0, f32))
+                mean = jnp.sum(x * w, axis=axes, dtype=f32) / denom
+                xc = (x - mean.astype(dt)) * w
+                var = jnp.sum(xc * xc, axis=axes, dtype=f32) / denom
             else:
-                mean = jnp.mean(x, axis=axes)
-                var = jnp.var(x, axis=axes)
+                mean = jnp.mean(x, axis=axes, dtype=f32)
+                xc = x - mean.astype(dt)
+                var = jnp.mean(xc * xc, axis=axes, dtype=f32)
             new_state = {
                 "mean": self.decay * state["mean"] + (1.0 - self.decay) * mean,
                 "var": self.decay * state["var"] + (1.0 - self.decay) * var,
             }
         else:
-            mean, var = state["mean"], state["var"]
+            mean, var = state["mean"].astype(f32), state["var"].astype(f32)
             new_state = state
         inv = lax.rsqrt(var + self.eps)
-        y = (x - mean) * inv
         if self.use_gamma_beta and params:
-            y = y * params["gamma"] + params["beta"]
+            a = params["gamma"].astype(f32) * inv
+            b = params["beta"].astype(f32) - mean * a
+        else:
+            a = inv
+            b = -mean * inv
+        y = x * a.astype(dt) + b.astype(dt)
         return y, new_state
 
 

@@ -141,6 +141,29 @@ def _zeros_like_tree(params):
     return _tmap(jnp.zeros_like, params)
 
 
+_LOW_PRECISION = (jnp.bfloat16, jnp.float16)
+
+
+def _mixed_precision(raw: Updater) -> Updater:
+    """Mixed-precision wrapper: accumulators live in float32, the update is
+    computed in float32, and only the returned delta is cast to the param
+    dtype — so bf16 training (the TPU default) keeps f32 optimizer statistics
+    and params stay bf16 across steps (no silent f32 promotion)."""
+
+    def up32(x):
+        return x.astype(jnp.float32) if x.dtype in _LOW_PRECISION else x
+
+    def init(params):
+        return raw.init(_tmap(up32, params))
+
+    def update(g, s, params, step):
+        upd, ns = raw.update(_tmap(up32, g), s, _tmap(up32, params), step)
+        upd = _tmap(lambda u, p: u.astype(p.dtype), upd, params)
+        return upd, ns
+
+    return Updater(init, update, raw.spec)
+
+
 def make_updater(spec: Any) -> Updater:
     """Build an :class:`Updater` from a spec.
 
@@ -148,6 +171,10 @@ def make_updater(spec: Any) -> Updater:
     (DL4J convention: ``GradientUpdater.applyUpdater`` rewrites the gradient
     into the update in-place; here it is pure).
     """
+    return _mixed_precision(_make_raw_updater(spec))
+
+
+def _make_raw_updater(spec: Any) -> Updater:
     cfg = normalize_updater(spec)
     t = cfg["type"]
     sched = cfg.get("schedule")

@@ -1,0 +1,82 @@
+"""bf16 training stability: params must STAY bf16 across steps (no silent
+f32 promotion through the updater or BatchNorm), while optimizer
+accumulators are kept in f32 (mixed precision — updaters._mixed_precision).
+
+Round-3 regression: before the fix, step 2 of any bf16 model failed with a
+conv dtype mismatch because f32 LR scalars promoted the params; one-step
+tests and the one-step multichip dryrun never caught it.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers import BatchNorm, Conv2D, Dense, OutputLayer, Subsampling2D
+from deeplearning4j_tpu.nn.model import MultiLayerConfiguration, MultiLayerNetwork
+from deeplearning4j_tpu.train.updaters import make_updater
+
+
+def _bf16_cnn(updater):
+    return MultiLayerConfiguration(
+        layers=(
+            Conv2D(n_out=8, kernel=(3, 3), activation="relu", convolution_mode="same"),
+            BatchNorm(),
+            Subsampling2D(kernel=(2, 2), stride=(2, 2)),
+            Dense(n_out=16, activation="relu"),
+            OutputLayer(n_out=4, activation="softmax"),
+        ),
+        input_type=InputType.convolutional(8, 8, 1),
+        updater=updater,
+        dtype="bfloat16",
+        seed=7,
+    )
+
+
+@pytest.mark.parametrize("updater", ["sgd", "adam", "nesterovs", "rmsprop", "amsgrad"])
+def test_bf16_params_stable_across_steps(updater):
+    model = MultiLayerNetwork(_bf16_cnn({"type": updater, "lr": 1e-2})).init()
+    rs = np.random.RandomState(0)
+    x = rs.rand(4, 8, 8, 1).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 4)]
+    model.fit((x, y), epochs=3)  # >1 step: promotion surfaced at step 2
+    for leaf in jax.tree_util.tree_leaves(model.params):
+        assert leaf.dtype == jnp.bfloat16
+
+
+def test_bf16_opt_state_is_f32():
+    model = MultiLayerNetwork(_bf16_cnn({"type": "adam", "lr": 1e-2})).init()
+    acc = [l for l in jax.tree_util.tree_leaves(model.opt_state)]
+    assert acc, "adam must have accumulators"
+    for leaf in acc:
+        assert leaf.dtype == jnp.float32
+
+
+def test_mixed_precision_update_matches_f32_math():
+    """The bf16 update must equal the f32 update computed on upcast grads,
+    rounded once to bf16 at the end."""
+    upd = make_updater({"type": "adam", "lr": 1e-2})
+    p16 = {"W": jnp.asarray(np.linspace(-1, 1, 8), jnp.bfloat16)}
+    p32 = {"W": p16["W"].astype(jnp.float32)}
+    g16 = {"W": jnp.asarray(np.linspace(0.5, -0.5, 8), jnp.bfloat16)}
+    s = upd.init(p16)
+    d16, _ = upd.update(g16, s, p16, 0)
+    d32, _ = upd.update({"W": g16["W"].astype(jnp.float32)}, upd.init(p32), p32, 0)
+    assert d16["W"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(d16["W"], np.float32),
+        np.asarray(d32["W"].astype(jnp.bfloat16), np.float32),
+    )
+
+
+def test_bf16_batchnorm_running_stats_f32_and_sane():
+    model = MultiLayerNetwork(_bf16_cnn("sgd")).init()
+    rs = np.random.RandomState(1)
+    x = (rs.rand(16, 8, 8, 1) * 3 + 1).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 16)]
+    model.fit((x, y), epochs=5)
+    bn_state = model.state[1]
+    assert bn_state["mean"].dtype == jnp.float32
+    assert float(jnp.max(bn_state["var"])) >= 0.0
+    assert np.isfinite(np.asarray(bn_state["mean"], np.float32)).all()
