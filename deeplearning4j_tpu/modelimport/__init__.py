@@ -11,9 +11,12 @@ from deeplearning4j_tpu.modelimport.keras import (
     KerasModelImport,
     UnsupportedKerasConfigurationError,
 )
+from deeplearning4j_tpu.modelimport.dl4j import export_dl4j_zip, import_dl4j_zip
 
 __all__ = [
     "KerasModelImport",
     "InvalidKerasConfigurationError",
     "UnsupportedKerasConfigurationError",
+    "import_dl4j_zip",
+    "export_dl4j_zip",
 ]

@@ -1,0 +1,357 @@
+"""DL4J model-zip interop (modelimport/dl4j.py).
+
+The crucial check is INDEPENDENCE: the hand-built fixture's expected outputs
+are computed with a pure-NumPy NCHW forward pass that re-implements the
+reference semantics (conv truncate mode, (c,h,w) flattening, F-order dense
+weights, [g,f,o,i] LSTM gate blocks with [wFF,wOO,wGG] peepholes) straight
+from the nn/params/*.java + LSTMHelpers.java layouts — NOT via the importer's
+own mapping. If the importer's NCHW->NHWC / F-order / gate permutation were
+wrong, these tests would catch it.
+"""
+
+import io
+import json
+import os
+import zipfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport.dl4j import (
+    export_dl4j_zip,
+    import_dl4j_zip,
+    read_nd4j,
+    write_nd4j,
+)
+from deeplearning4j_tpu.nn.input_type import InputType
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+# ---------------------------------------------------------------------------
+# Hand-built DL4J zip + independent NumPy forward
+# ---------------------------------------------------------------------------
+
+def _act_relu(x):
+    return np.maximum(x, 0.0)
+
+
+def _softmax(x):
+    e = np.exp(x - x.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+def _np_conv_nchw(x, W, b, stride=(1, 1)):
+    """x [B,C,H,W], W [O,C,kh,kw] truncate mode."""
+    B, C, H, Wd = x.shape
+    O, _, kh, kw = W.shape
+    sh, sw = stride
+    oh = (H - kh) // sh + 1
+    ow = (Wd - kw) // sw + 1
+    out = np.zeros((B, O, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = x[:, :, i * sh:i * sh + kh, j * sw:j * sw + kw]  # B,C,kh,kw
+            out[:, :, i, j] = np.tensordot(patch, W, axes=([1, 2, 3], [1, 2, 3]))
+    return out + b[None, :, None, None]
+
+
+def _np_maxpool_nchw(x, k=(2, 2), s=(2, 2)):
+    B, C, H, W = x.shape
+    oh, ow = (H - k[0]) // s[0] + 1, (W - k[1]) // s[1] + 1
+    out = np.zeros((B, C, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            out[:, :, i, j] = x[:, :, i * s[0]:i * s[0] + k[0],
+                                j * s[1]:j * s[1] + k[1]].max((2, 3))
+    return out
+
+
+def _build_cnn_zip(path):
+    """conv(2 filters 3x3) -> maxpool 2x2 -> dense(5,relu) -> output(3).
+    Input 1x6x6. Returns (x_nchw, expected_probs)."""
+    rs = np.random.RandomState(42)
+    convW = rs.randn(2, 1, 3, 3).astype(np.float32) * 0.5   # (O,C,kh,kw)
+    convB = rs.randn(2).astype(np.float32) * 0.1
+    # conv out 4x4 -> pool 2x2 -> flatten (c=2,h=2,w=2) = 8
+    denseW = rs.randn(8, 5).astype(np.float32) * 0.5        # (nIn,nOut)
+    denseB = rs.randn(5).astype(np.float32) * 0.1
+    outW = rs.randn(5, 3).astype(np.float32) * 0.5
+    outB = rs.randn(3).astype(np.float32) * 0.1
+
+    flat = np.concatenate([
+        convB, convW.ravel(),                      # conv: [b | W C-order]
+        denseW.ravel(order="F"), denseB,           # dense: [W F-order | b]
+        outW.ravel(order="F"), outB,
+    ]).astype(np.float32)
+
+    conf = {
+        "backprop": True, "pretrain": False, "backpropType": "Standard",
+        "confs": [
+            {"seed": 1, "layer": {"convolution": {
+                "nin": 1, "nout": 2, "kernelSize": [3, 3], "stride": [1, 1],
+                "padding": [0, 0], "convolutionMode": "Truncate", "hasBias": True,
+                "activationFn": {"ReLU": {}},
+                "iUpdater": {"Sgd": {"learningRate": 0.1}}}}},
+            {"layer": {"subsampling": {
+                "kernelSize": [2, 2], "stride": [2, 2], "padding": [0, 0],
+                "poolingType": "MAX", "convolutionMode": "Truncate"}}},
+            {"layer": {"dense": {
+                "nin": 8, "nout": 5, "activationFn": {"ReLU": {}}}}},
+            {"layer": {"output": {
+                "nin": 5, "nout": 3, "activationFn": {"Softmax": {}},
+                "lossFn": {"@class": "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"}}}},
+        ],
+        "inputPreProcessors": {"0": {"feedForwardToCnn": {
+            "inputHeight": 6, "inputWidth": 6, "numChannels": 1}}},
+    }
+    buf = io.BytesIO()
+    write_nd4j(buf, flat[None, :], "FLOAT")
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("configuration.json", json.dumps(conf))
+        zf.writestr("coefficients.bin", buf.getvalue())
+
+    x = rs.rand(4, 1, 6, 6).astype(np.float32)
+    h = _act_relu(_np_conv_nchw(x, convW, convB))
+    h = _np_maxpool_nchw(h)
+    h = h.reshape(4, -1)          # NCHW flatten = (c,h,w) order, like DL4J
+    h = _act_relu(h @ denseW + denseB)
+    probs = _softmax(h @ outW + outB)
+    return x, probs
+
+
+class TestNd4jBinary:
+    def test_roundtrip_shapes_orders(self):
+        for arr in (np.arange(12, dtype=np.float32).reshape(3, 4),
+                    np.random.RandomState(0).rand(1, 17).astype(np.float32),
+                    np.random.RandomState(1).rand(2, 3, 4).astype(np.float32)):
+            buf = io.BytesIO()
+            write_nd4j(buf, arr, "FLOAT")
+            buf.seek(0)
+            back = read_nd4j(buf)
+            np.testing.assert_array_equal(np.asarray(back).squeeze(), arr.squeeze())
+
+    def test_double_and_int_buffers(self):
+        buf = io.BytesIO()
+        write_nd4j(buf, np.asarray([[1.5, -2.25]]), "DOUBLE")
+        buf.seek(0)
+        out = read_nd4j(buf)
+        assert out.dtype == np.float64
+        np.testing.assert_array_equal(out, [[1.5, -2.25]])
+
+
+class TestImportCnn:
+    def test_forward_matches_independent_numpy_nchw(self, tmp_path):
+        p = str(tmp_path / "cnn.zip")
+        x_nchw, expected = _build_cnn_zip(p)
+        model = import_dl4j_zip(p)
+        x_nhwc = np.transpose(x_nchw, (0, 2, 3, 1)).reshape(4, -1)  # conv_flat input
+        got = np.asarray(model.output(x_nhwc))
+        np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+    def test_updater_imported(self, tmp_path):
+        p = str(tmp_path / "cnn.zip")
+        _build_cnn_zip(p)
+        model = import_dl4j_zip(p)
+        from deeplearning4j_tpu.train.updaters import normalize_updater
+        assert normalize_updater(model.conf.updater)["type"] == "sgd"
+
+    def test_wrong_length_rejected(self, tmp_path):
+        p = str(tmp_path / "cnn.zip")
+        _build_cnn_zip(p)
+        with zipfile.ZipFile(p) as zf:
+            conf = zf.read("configuration.json")
+            coeff = zf.read("coefficients.bin")
+        flat = read_nd4j(io.BytesIO(coeff)).ravel()
+        buf = io.BytesIO()
+        write_nd4j(buf, flat[None, :-3], "FLOAT")
+        p2 = str(tmp_path / "bad.zip")
+        with zipfile.ZipFile(p2, "w") as zf:
+            zf.writestr("configuration.json", conf)
+            zf.writestr("coefficients.bin", buf.getvalue())
+        with pytest.raises(ValueError, match="exhaust|mismatch"):
+            import_dl4j_zip(p2)
+
+
+class TestImportLSTM:
+    def _np_dl4j_graves_lstm(self, x, wx, rw, b):
+        """Independent NumPy GravesLSTM in DL4J's own layout: blocks
+        [g,f,o,i]; peephole cols [wFF,wOO,wGG] (LSTMHelpers.java:71)."""
+        B, T, _ = x.shape
+        H = rw.shape[0]
+        wff, woo, wgg = rw[:, 4 * H], rw[:, 4 * H + 1], rw[:, 4 * H + 2]
+        rw4 = rw[:, :4 * H]
+        h = np.zeros((B, H), np.float32)
+        c = np.zeros((B, H), np.float32)
+        outs = []
+        sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+        for t in range(T):
+            z = x[:, t] @ wx + h @ rw4 + b
+            g = np.tanh(z[:, 0:H])                       # candidate
+            f = sig(z[:, H:2 * H] + c * wff)             # forget (prev cell)
+            i = sig(z[:, 3 * H:4 * H] + c * wgg)         # input gate (prev cell)
+            c = f * c + i * g
+            o = sig(z[:, 2 * H:3 * H] + c * woo)         # output (current cell)
+            h = o * np.tanh(c)
+            outs.append(h)
+        return np.stack(outs, 1)
+
+    def test_graves_lstm_forward_matches_dl4j_layout_numpy(self, tmp_path):
+        rs = np.random.RandomState(7)
+        n_in, H, V = 3, 4, 2
+        wx = (rs.randn(n_in, 4 * H) * 0.4).astype(np.float32)
+        rw = (rs.randn(H, 4 * H + 3) * 0.4).astype(np.float32)
+        b = (rs.randn(4 * H) * 0.1).astype(np.float32)
+        outW = (rs.randn(H, V) * 0.5).astype(np.float32)
+        outB = np.zeros(V, np.float32)
+        flat = np.concatenate([
+            wx.ravel(order="F"), rw.ravel(order="F"), b,
+            outW.ravel(order="F"), outB]).astype(np.float32)
+        conf = {
+            "backprop": True, "backpropType": "Standard",
+            "confs": [
+                {"seed": 5, "layer": {"gravesLSTM": {
+                    "nin": n_in, "nout": H, "activationFn": {"TanH": {}},
+                    "forgetGateBiasInit": 0.0}}},
+                {"layer": {"rnnoutput": {
+                    "nin": H, "nout": V, "activationFn": {"Softmax": {}},
+                    "lossFn": {"@class": "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"}}}},
+            ],
+            "inputPreProcessors": {},
+        }
+        p = str(tmp_path / "lstm.zip")
+        buf = io.BytesIO()
+        write_nd4j(buf, flat[None, :], "FLOAT")
+        with zipfile.ZipFile(p, "w") as zf:
+            zf.writestr("configuration.json", json.dumps(conf))
+            zf.writestr("coefficients.bin", buf.getvalue())
+
+        model = import_dl4j_zip(p)
+        x = rs.rand(2, 5, n_in).astype(np.float32)
+        got = np.asarray(model.output(x))
+        h = self._np_dl4j_graves_lstm(x, wx, rw, b)
+        expected = _softmax(h @ outW + outB)
+        np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+class TestExportRoundTrip:
+    def _small_model(self):
+        from deeplearning4j_tpu.nn.layers import (
+            BatchNorm, Conv2D, Dense, GravesLSTM, OutputLayer, Subsampling2D)
+        from deeplearning4j_tpu.nn.model import (
+            MultiLayerConfiguration, MultiLayerNetwork)
+        conf = MultiLayerConfiguration(
+            layers=(
+                Conv2D(n_out=3, kernel=(3, 3), activation="relu"),
+                BatchNorm(),
+                Subsampling2D(kernel=(2, 2), stride=(2, 2)),
+                Dense(n_out=6, activation="relu"),
+                OutputLayer(n_out=4, activation="softmax"),
+            ),
+            input_type=InputType.convolutional(8, 8, 2),
+            updater={"type": "sgd", "lr": 0.05},
+            seed=11,
+        )
+        return MultiLayerNetwork(conf).init()
+
+    def test_cnn_bn_roundtrip(self, tmp_path):
+        model = self._small_model()
+        rs = np.random.RandomState(3)
+        x = rs.rand(6, 8, 8, 2).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 6)]
+        model.fit((x, y), epochs=2)  # give BN non-trivial running stats
+        p = str(tmp_path / "m.zip")
+        export_dl4j_zip(model, p)
+        back = import_dl4j_zip(p, input_type=InputType.convolutional(8, 8, 2))
+        np.testing.assert_allclose(
+            np.asarray(back.output(x)), np.asarray(model.output(x)),
+            rtol=1e-5, atol=1e-6)
+
+    def test_lstm_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.nn.layers import GravesLSTM, RnnOutputLayer
+        from deeplearning4j_tpu.nn.model import (
+            MultiLayerConfiguration, MultiLayerNetwork)
+        conf = MultiLayerConfiguration(
+            layers=(GravesLSTM(n_out=5),
+                    RnnOutputLayer(n_out=3, activation="softmax")),
+            input_type=InputType.recurrent(4, 6),
+            seed=2,
+        )
+        model = MultiLayerNetwork(conf).init()
+        rs = np.random.RandomState(0)
+        # randomize the (zero-init) peepholes so the mapping is exercised
+        import jax.numpy as jnp
+        p0 = dict(model.params[0])
+        p0["peephole"] = jnp.asarray(rs.randn(15).astype(np.float32) * 0.3)
+        model.params = (p0,) + tuple(model.params[1:])
+        x = rs.rand(2, 6, 4).astype(np.float32)
+        p = str(tmp_path / "lstm.zip")
+        export_dl4j_zip(model, p)
+        back = import_dl4j_zip(p)
+        np.testing.assert_allclose(
+            np.asarray(back.output(x)), np.asarray(model.output(x)),
+            rtol=1e-5, atol=1e-6)
+
+
+class TestRoundTripEdgeCases:
+    def test_leakyrelu_biasless_mse_roundtrip(self, tmp_path):
+        """Regression: activation/loss name maps must use REGISTERED names,
+        hasBias must round-trip, unmapped names must raise (not corrupt)."""
+        from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+        from deeplearning4j_tpu.nn.model import (
+            MultiLayerConfiguration, MultiLayerNetwork)
+        conf = MultiLayerConfiguration(
+            layers=(Dense(n_out=6, activation="leakyrelu", has_bias=False),
+                    OutputLayer(n_out=3, activation="softmax", loss="mse")),
+            input_type=InputType.feed_forward(4), seed=1)
+        m = MultiLayerNetwork(conf).init()
+        p = str(tmp_path / "lr.zip")
+        export_dl4j_zip(m, p)
+        back = import_dl4j_zip(p)
+        x = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(back.output(x)), np.asarray(m.output(x)), rtol=1e-5)
+
+    def test_unmapped_activation_raises(self, tmp_path):
+        from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+        from deeplearning4j_tpu.nn.model import (
+            MultiLayerConfiguration, MultiLayerNetwork)
+        conf = MultiLayerConfiguration(
+            layers=(Dense(n_out=6, activation="gelu"),
+                    OutputLayer(n_out=3, activation="softmax")),
+            input_type=InputType.feed_forward(4), seed=1)
+        m = MultiLayerNetwork(conf).init()
+        with pytest.raises(ValueError, match="no DL4J equivalent"):
+            export_dl4j_zip(m, str(tmp_path / "g.zip"))
+
+
+class TestTransferOnImported:
+    def test_surgery_on_imported_model(self, tmp_path):
+        p = str(tmp_path / "cnn.zip")
+        _build_cnn_zip(p)
+        model = import_dl4j_zip(p)
+        from deeplearning4j_tpu.nn.transfer import TransferLearning
+        new = (TransferLearning.builder(model)
+               .set_feature_extractor(2)
+               .n_out_replace(-1, 7)
+               .build())
+        rs = np.random.RandomState(1)
+        x = rs.rand(3, 36).astype(np.float32)
+        out = np.asarray(new.output(x))
+        assert out.shape == (3, 7)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-4)
+
+
+class TestCommittedFixture:
+    """Serialization-stability contract (regressiontest/RegressionTest080.java
+    equivalent): the committed zip bytes must keep importing and producing
+    the committed golden outputs in every future round."""
+
+    def test_fixture_imports_and_matches_golden(self):
+        zpath = os.path.join(FIXDIR, "dl4j_cnn_tiny.zip")
+        gpath = os.path.join(FIXDIR, "dl4j_cnn_tiny_golden.npz")
+        assert os.path.exists(zpath), "committed fixture missing"
+        model = import_dl4j_zip(zpath)
+        g = np.load(gpath)
+        got = np.asarray(model.output(g["x"]))
+        np.testing.assert_allclose(got, g["y"], rtol=1e-5, atol=1e-6)

@@ -1,0 +1,118 @@
+"""init_pretrained (ZooModel.initPretrained parity) + ModelGuesser load_any."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.pretrained import init_pretrained, pretrained_path
+from deeplearning4j_tpu.models.zoo_graph import ResNet50
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.utils.guesser import load_any
+from deeplearning4j_tpu.utils.serialization import save_network
+
+
+def _tiny_resnet(num_classes=7):
+    return ResNet50(height=32, width=32, num_classes=num_classes, seed=3)
+
+
+class TestInitPretrained:
+    def test_full_transplant_reproduces_outputs(self, tmp_path):
+        src = ComputationGraph(_tiny_resnet()).init()
+        p = str(tmp_path / "resnet_tiny.zip")
+        save_network(src, p)
+        model = init_pretrained(_tiny_resnet(), weights=p)
+        rs = np.random.RandomState(0)
+        x = rs.rand(2, 32, 32, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(model.output(x)), np.asarray(src.output(x)),
+            rtol=1e-5, atol=1e-6)
+        assert not model.pretrained_summary["skipped"]
+
+    def test_backbone_transplant_with_new_head(self, tmp_path):
+        src = ComputationGraph(_tiny_resnet(num_classes=7)).init()
+        p = str(tmp_path / "resnet_tiny.zip")
+        save_network(src, p)
+        model = init_pretrained(_tiny_resnet(num_classes=13), weights=p)
+        s = model.pretrained_summary
+        assert "out" in s["skipped"]            # mismatched classifier head
+        assert len(s["loaded"]) > 50            # the whole backbone
+        rs = np.random.RandomState(0)
+        x = rs.rand(2, 32, 32, 3).astype(np.float32)
+        out = np.asarray(model.output(x))
+        assert out.shape == (2, 13)
+
+    def test_cache_resolution_and_missing_error(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_HOME", str(tmp_path))
+        with pytest.raises(FileNotFoundError, match="air-gapped"):
+            pretrained_path("resnet50")
+        os.makedirs(tmp_path / "models")
+        src = ComputationGraph(_tiny_resnet()).init()
+        save_network(src, str(tmp_path / "models" / "resnet50.zip"))
+        model = init_pretrained(_tiny_resnet(), name="resnet50")
+        assert model.pretrained_summary["loaded"]
+
+    def test_bf16_destination_dtype_preserved(self, tmp_path):
+        """Regression: an f32 checkpoint loaded into a bf16 config must cast
+        to bf16 (mixed-dtype params break the train step)."""
+        import jax
+        import jax.numpy as jnp
+        src = ComputationGraph(_tiny_resnet()).init()
+        p = str(tmp_path / "r.zip")
+        save_network(src, p)
+        m = init_pretrained(
+            ResNet50(height=32, width=32, num_classes=7, seed=3, dtype="bfloat16"),
+            weights=p)
+        assert all(l.dtype == jnp.bfloat16
+                   for l in jax.tree_util.tree_leaves(m.params))
+        assert not m.pretrained_summary["skipped"]
+
+    def test_wrong_architecture_rejected(self, tmp_path):
+        from deeplearning4j_tpu.models import LeNet5
+        from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+        mln = MultiLayerNetwork(LeNet5()).init()
+        p = str(tmp_path / "lenet.zip")
+        save_network(mln, p)
+        with pytest.raises(ValueError, match="MultiLayerNetwork"):
+            init_pretrained(_tiny_resnet(), weights=p)
+
+
+class TestLoadAny:
+    def test_native_zip(self, tmp_path):
+        src = ComputationGraph(_tiny_resnet()).init()
+        p = str(tmp_path / "m.zip")
+        save_network(src, p)
+        m = load_any(p)
+        assert isinstance(m, ComputationGraph)
+
+    def test_dl4j_zip(self, tmp_path):
+        import sys
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_dl4j_import import _build_cnn_zip
+        p = str(tmp_path / "dl4j.zip")
+        _build_cnn_zip(p)
+        from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+        assert isinstance(load_any(p), MultiLayerNetwork)
+
+    def test_config_json(self, tmp_path):
+        conf = _tiny_resnet()
+        p = str(tmp_path / "conf.json")
+        with open(p, "w") as f:
+            f.write(conf.to_json())
+        from deeplearning4j_tpu.nn.graph import ComputationGraphConfiguration
+        assert isinstance(load_any(p), ComputationGraphConfiguration)
+
+    def test_keras_h5(self):
+        fix = os.path.join(os.path.dirname(__file__), "fixtures")
+        h5s = [f for f in os.listdir(fix) if f.endswith(".h5")]
+        if not h5s:
+            pytest.skip("no keras fixture")
+        m = load_any(os.path.join(fix, sorted(h5s)[0]))
+        assert hasattr(m, "params")
+
+    def test_garbage_rejected_with_attempts(self, tmp_path):
+        p = str(tmp_path / "junk.bin")
+        with open(p, "wb") as f:
+            f.write(b"\x00\x01\x02 not a model")
+        with pytest.raises(ValueError, match="no loader succeeded"):
+            load_any(p)
