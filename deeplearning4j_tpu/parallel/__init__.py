@@ -14,6 +14,13 @@ from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 from deeplearning4j_tpu.parallel.inference import ParallelInference
 from deeplearning4j_tpu.parallel.context import current_mesh, use_mesh
+from deeplearning4j_tpu.parallel.distributed import (
+    global_array,
+    init_distributed,
+    is_multihost,
+    replicate_global,
+    shutdown_distributed,
+)
 from deeplearning4j_tpu.parallel.ring import local_attention, ring_self_attention
 from deeplearning4j_tpu.parallel.pipeline import PipelineParallel, stack_stage_params
 from deeplearning4j_tpu.parallel.tp import ShardedTrainer, tp_param_shardings
@@ -22,5 +29,6 @@ __all__ = [
     "MeshSpec", "make_mesh", "ParallelWrapper", "ParallelInference",
     "current_mesh", "use_mesh", "local_attention", "ring_self_attention",
     "PipelineParallel", "stack_stage_params", "ShardedTrainer",
-    "tp_param_shardings",
+    "tp_param_shardings", "init_distributed", "shutdown_distributed",
+    "is_multihost", "global_array", "replicate_global",
 ]

@@ -1,0 +1,110 @@
+"""Multi-host (multi-process) training setup.
+
+Capability parity with the reference's Spark scaleout value proposition —
+multi-NODE training (dl4j-spark ParameterAveragingTrainingMaster.java:308,
+SharedTrainingMaster.java:304) — re-designed TPU-first: instead of a Spark
+driver shipping parameter/gradient messages, every host runs the SAME SPMD
+program under ``jax.distributed``; the mesh spans all hosts' devices and XLA
+lowers the gradient psum onto ICI/DCN. There is no separate "training
+master": ``ParallelWrapper`` works unchanged, with each host feeding its
+process-local shard of the global batch.
+
+On real TPU pods, ``init_distributed()`` with no arguments picks up the TPU
+runtime's cluster environment. For CPU testing (and CI), pass the
+coordinator/process arguments explicitly and collectives run over gloo —
+tests/test_multihost.py launches 2 processes x 4 virtual devices and asserts
+loss parity with a single-process run.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     local_device_count: Optional[int] = None,
+                     cpu_collectives: str = "gloo") -> None:
+    """Join (or form) a multi-process JAX cluster.
+
+    Must run before any JAX backend initialization. On TPU pods all arguments
+    are optional (the plugin discovers the cluster); on CPU/GPU pass
+    ``coordinator_address`` ("host:port"), ``num_processes``, ``process_id``.
+    ``local_device_count``: virtual CPU devices for this process (testing).
+    """
+    if local_device_count is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        flag = f"--xla_force_host_platform_device_count={local_device_count}"
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
+
+    import jax
+
+    if cpu_collectives and os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        jax.config.update("jax_cpu_collectives_implementation", cpu_collectives)
+    # Forward each argument independently — jax.distributed.initialize
+    # accepts any subset (the rest come from the environment / TPU runtime).
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+
+
+def shutdown_distributed() -> None:
+    import jax
+
+    jax.distributed.shutdown()
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def is_multihost() -> bool:
+    return process_count() > 1
+
+
+def global_array(mesh, local_data: np.ndarray, spec=None):
+    """Assemble a jax.Array sharded over ``mesh`` from this process's local
+    rows. ``spec`` defaults to batch-sharding over the ``data`` axis. In
+    single-process mode this is a plain device_put (same semantics)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if spec is None:
+        spec = P("data", *([None] * (np.ndim(local_data) - 1)))
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(local_data, sharding)
+    return jax.make_array_from_process_local_data(sharding, np.asarray(local_data))
+
+
+def replicate_global(mesh, tree):
+    """Replicate a pytree onto every device of a (possibly multi-host) mesh.
+    Every process must hold the same values (guaranteed when params were
+    initialized from the same seed). Leaves already carrying the target
+    sharding pass through untouched (no D2H round-trip on repeated calls)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    repl = NamedSharding(mesh, P())
+    multihost = jax.process_count() > 1
+
+    def put(a):
+        if isinstance(a, jax.Array) and a.sharding == repl:
+            return a
+        if multihost:
+            return jax.make_array_from_process_local_data(repl, np.asarray(a))
+        return jax.device_put(a, repl)
+
+    return jax.tree_util.tree_map(put, tree)

@@ -45,20 +45,30 @@ class ParallelWrapper:
         self.mesh = mesh if mesh is not None else make_mesh(MeshSpec())
         self.n_data = self.mesh.shape["data"]
         self._repl = NamedSharding(self.mesh, P())
+        # Multi-host (jax.distributed): every process runs this same fit()
+        # on its process-LOCAL batch rows; global batch = concat over
+        # processes in process order. Local batches must be the same size on
+        # every host (the padding/loss-rescale math assumes it). Padding
+        # granularity is the per-process shard count.
+        self._nproc = jax.process_count()
+        self._pad_quantum = max(self.n_data // self._nproc, 1)
 
     def _shard(self, arr):
         if arr is None:
             return None
-        arr = jnp.asarray(arr, self.model.dtype)
+        from deeplearning4j_tpu.parallel.distributed import global_array
+
+        arr = np.asarray(arr, self.model.dtype)  # before .ndim: lists welcome
         spec = P("data", *([None] * (arr.ndim - 1)))
-        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+        return global_array(self.mesh, arr, spec)
 
     def _replicate_model(self):
-        put = lambda t: jax.device_put(t, self._repl)
-        self.model.params = jax.tree_util.tree_map(put, self.model.params)
-        self.model.state = jax.tree_util.tree_map(put, self.model.state)
+        from deeplearning4j_tpu.parallel.distributed import replicate_global
+
+        self.model.params = replicate_global(self.mesh, self.model.params)
+        self.model.state = replicate_global(self.mesh, self.model.state)
         if self.model.opt_state is not None:
-            self.model.opt_state = jax.tree_util.tree_map(put, self.model.opt_state)
+            self.model.opt_state = replicate_global(self.mesh, self.model.opt_state)
 
     def _pad_to_shardable(self, arrs):
         """Tile members of a batch so the leading axis divides n_data.
@@ -68,9 +78,9 @@ class ParallelWrapper:
         ``_padded_lmask`` — or they would silently double-weight samples in
         the gradient."""
         n = next(len(a) for a in arrs if a is not None)
-        if n % self.n_data == 0:
+        if n % self._pad_quantum == 0:
             return arrs, n
-        pad = self.n_data - n % self.n_data
+        pad = self._pad_quantum - n % self._pad_quantum
 
         def _pad(a):
             if a is None:

@@ -1,0 +1,64 @@
+"""Subprocess worker for tests/test_multihost.py: one training process in a
+2-process CPU cluster (4 virtual devices each -> 8-device global mesh)."""
+
+import json
+import os
+import sys
+
+
+def main():
+    idx = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+    outdir = sys.argv[4]
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from __graft_entry__ import _provision_cpu_mesh
+
+    _provision_cpu_mesh(4)  # BEFORE distributed init: platform + flags + axon pop
+
+    from deeplearning4j_tpu.parallel.distributed import init_distributed
+
+    init_distributed(f"127.0.0.1:{port}", num_processes=nproc, process_id=idx)
+
+    import jax
+    import numpy as np
+
+    assert jax.process_count() == nproc
+    assert len(jax.devices()) == 4 * nproc, f"global devices {len(jax.devices())}"
+
+    from deeplearning4j_tpu.nn.input_type import InputType
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.model import MultiLayerConfiguration, MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+    from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+    conf = MultiLayerConfiguration(
+        layers=(Dense(n_out=16, activation="relu"),
+                Dense(n_out=8, activation="tanh"),
+                OutputLayer(n_out=4, activation="softmax")),
+        input_type=InputType.feed_forward(10),
+        updater={"type": "adam", "lr": 5e-3},
+        seed=77,  # same seed on every process -> identical init
+    )
+    model = MultiLayerNetwork(conf).init()
+
+    rs = np.random.RandomState(123)          # same global data everywhere
+    xg = rs.rand(16, 10).astype(np.float32)
+    yg = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 16)]
+    lo, hi = idx * 8, (idx + 1) * 8          # this host's rows
+
+    pw = ParallelWrapper(model, make_mesh(MeshSpec(data=8)))
+    pw.fit((xg[lo:hi], yg[lo:hi]), epochs=3)
+
+    if idx == 0:
+        leaves = [np.asarray(jax.device_get(l))
+                  for l in jax.tree_util.tree_leaves(model.params)]
+        np.savez(os.path.join(outdir, "mh_params.npz"),
+                 **{str(i): l for i, l in enumerate(leaves)})
+        with open(os.path.join(outdir, "mh_done.json"), "w") as f:
+            json.dump({"processes": nproc, "devices": len(jax.devices())}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""Multi-host training (parallel/distributed.py): 2 real subprocesses x 4
+virtual CPU devices train over an 8-device global mesh via gloo collectives,
+and the result must equal the single-process 8-device run on the same global
+batch — the SPMD replacement for the reference's multi-node Spark masters
+(SURVEY.md §2.5; SharedTrainingMaster.java:304)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "_multihost_worker.py")
+
+
+def test_two_process_training_matches_single_process(tmp_path):
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(i), "2", str(port), str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out.decode("utf-8", "replace"))
+    for i, p in enumerate(procs):
+        assert p.returncode == 0, f"worker {i} failed:\n{outs[i][-3000:]}"
+    assert os.path.exists(tmp_path / "mh_done.json")
+
+    # single-process reference on the SAME global batch (8 local devices)
+    from deeplearning4j_tpu.nn.input_type import InputType
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.model import MultiLayerConfiguration, MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+    from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+    import jax
+
+    conf = MultiLayerConfiguration(
+        layers=(Dense(n_out=16, activation="relu"),
+                Dense(n_out=8, activation="tanh"),
+                OutputLayer(n_out=4, activation="softmax")),
+        input_type=InputType.feed_forward(10),
+        updater={"type": "adam", "lr": 5e-3},
+        seed=77,
+    )
+    model = MultiLayerNetwork(conf).init()
+    rs = np.random.RandomState(123)
+    xg = rs.rand(16, 10).astype(np.float32)
+    yg = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 16)]
+    pw = ParallelWrapper(model, make_mesh(MeshSpec(data=8)))
+    pw.fit((xg, yg), epochs=3)
+
+    got = np.load(tmp_path / "mh_params.npz")
+    ref_leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(model.params)]
+    assert len(got.files) == len(ref_leaves)
+    for i, ref in enumerate(ref_leaves):
+        np.testing.assert_allclose(
+            got[str(i)], ref, rtol=1e-5, atol=1e-6,
+            err_msg=f"param leaf {i} diverged between multi-host and single-process")
