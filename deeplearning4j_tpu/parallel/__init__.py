@@ -21,6 +21,7 @@ from deeplearning4j_tpu.parallel.distributed import (
     replicate_global,
     shutdown_distributed,
 )
+from deeplearning4j_tpu.parallel.gpipe import GPipeTrainer
 from deeplearning4j_tpu.parallel.ring import local_attention, ring_self_attention
 from deeplearning4j_tpu.parallel.pipeline import PipelineParallel, stack_stage_params
 from deeplearning4j_tpu.parallel.tp import ShardedTrainer, tp_param_shardings
@@ -28,7 +29,7 @@ from deeplearning4j_tpu.parallel.tp import ShardedTrainer, tp_param_shardings
 __all__ = [
     "MeshSpec", "make_mesh", "ParallelWrapper", "ParallelInference",
     "current_mesh", "use_mesh", "local_attention", "ring_self_attention",
-    "PipelineParallel", "stack_stage_params", "ShardedTrainer",
+    "GPipeTrainer", "PipelineParallel", "stack_stage_params", "ShardedTrainer",
     "tp_param_shardings", "init_distributed", "shutdown_distributed",
     "is_multihost", "global_array", "replicate_global",
 ]

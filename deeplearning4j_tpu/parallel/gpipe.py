@@ -1,0 +1,318 @@
+"""GPipe pipeline parallelism as a FRAMEWORK feature: train any (stateless)
+MultiLayerConfiguration pipelined over the mesh's ``pipe`` axis.
+
+Beyond-reference capability (SURVEY.md §2.5 — the reference is data-parallel
+only). ``parallel/pipeline.py`` holds the low-level SPMD ring kernel; this
+module makes it a first-class trainer:
+
+- **Auto-partitioning**: the resolved layer list (preprocessors included) is
+  split into ``pipe``-many CONTIGUOUS stages balanced by parameter count.
+- **Heterogeneous stages in one SPMD program**: per-stage parameter pytrees
+  are raveled to f32 vectors, zero-padded to the longest stage, and stacked
+  [S, Lmax] — an ordinary array sharded P('pipe'). Each rank recovers ITS
+  stage's tree with a static unravel inside ``lax.switch(rank, branches)``;
+  XLA's conditional executes only the taken branch per device.
+- **Unequal boundary widths**: inter-stage activations are flattened to
+  [mb, Fmax] (max boundary width) with exact zero-pad on exit and slice +
+  reshape on entry — no lossy projection, so GPipe training is numerically
+  EQUIVALENT to single-device training (test_gpipe.py asserts parameter
+  equality against plain MultiLayerNetwork.fit).
+- **Real updater stack**: the configuration's updater (sgd/adam/rmsprop/...)
+  runs on the stacked vectors + loss head — elementwise transforms are
+  invariant to the ravel, so updates match the per-layer single-device math.
+- **Listeners** fire per iteration like MultiLayerNetwork.fit.
+- ``to_model()`` unravels the trained vectors back into an ordinary
+  MultiLayerNetwork for inference/serialization/evaluation.
+
+v1 limitations (explicit, checked): layers with running state (BatchNorm) or
+rng needs (dropout), per-layer updater overrides, gradient normalization,
+constraints, and masks are rejected with clear errors — the DP/TP paths
+cover those; this trainer targets the deep feed-forward/conv stacks where
+pipeline memory scaling matters.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.flatten_util import ravel_pytree
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.nn.model import MultiLayerNetwork, _iter_batches
+from deeplearning4j_tpu.parallel.ring import shard_map
+from deeplearning4j_tpu.train.updaters import make_updater
+
+
+def partition_layers(param_counts: Sequence[int], n_stages: int) -> List[Tuple[int, int]]:
+    """Contiguous [start, end) ranges balanced by parameter count (greedy
+    prefix split at target boundaries; every stage non-empty)."""
+    n = len(param_counts)
+    if n_stages > n:
+        raise ValueError(f"{n_stages} stages for {n} layers")
+    total = float(sum(param_counts)) or 1.0
+    bounds = [0]
+    acc = 0.0
+    for i, c in enumerate(param_counts):
+        acc += c
+        # must leave enough layers for the remaining stages
+        remaining_needed = n_stages - len(bounds)
+        if len(bounds) < n_stages and acc >= total * len(bounds) / n_stages \
+                and i + 1 <= n - remaining_needed:
+            bounds.append(i + 1)
+    while len(bounds) < n_stages:
+        bounds.append(min(bounds[-1] + 1, n - (n_stages - len(bounds))))
+    bounds.append(n)
+    return [(bounds[i], bounds[i + 1]) for i in range(n_stages)]
+
+
+class GPipeTrainer:
+    """Pipeline-parallel trainer for a MultiLayerConfiguration.
+
+    Usage::
+
+        mesh = make_mesh(MeshSpec(data=2, pipe=2))
+        tr = GPipeTrainer(conf, mesh, n_micro=4)
+        tr.fit((x, y), epochs=3)
+        model = tr.to_model()     # ordinary MultiLayerNetwork
+    """
+
+    def __init__(self, conf, mesh: Mesh, n_micro: int = 2,
+                 pipe_axis: str = "pipe", data_axis: str = "data"):
+        self.conf = conf
+        self.mesh = mesh
+        self.n_micro = n_micro
+        self.pipe_axis = pipe_axis
+        self.data_axis = data_axis
+        self.n_stages = mesh.shape[pipe_axis]
+        if self.n_stages < 2:
+            raise ValueError("GPipeTrainer needs a pipe axis of size >= 2")
+
+        # Resolve via an ordinary network (preprocessors, n_in inference,
+        # initial params) — single source of truth for layer semantics.
+        self._ref = MultiLayerNetwork(conf).init()
+        self._validate()
+
+        body = list(range(len(self._ref.layers) - 1))   # loss head excluded
+        self.head_idx = len(self._ref.layers) - 1
+        self.head_cfg = self._ref.layers[self.head_idx]
+        counts = [
+            sum(int(np.prod(np.shape(l)))
+                for l in jax.tree_util.tree_leaves(self._ref.params[i]))
+            for i in body
+        ]
+        self.stage_ranges = partition_layers(counts, self.n_stages)
+
+        self._build_stages()
+        self.updater = make_updater(conf.updater)
+        self.opt_state = self.updater.init((self.stacked, self.head_params))
+        self.iteration = 0
+        self.epoch = 0
+        self.listeners: list = []
+        self._step = None
+
+    # -- validation --------------------------------------------------------
+    def _validate(self):
+        for i, layer in enumerate(self._ref.layers):
+            name = type(layer).__name__
+            if jax.tree_util.tree_leaves(self._ref.state[i]):
+                raise NotImplementedError(
+                    f"GPipeTrainer v1: layer {i} ({name}) carries running "
+                    "state (BatchNorm?) — use DP/TP for stateful nets")
+            if getattr(layer, "dropout", 0.0):
+                raise NotImplementedError(
+                    f"GPipeTrainer v1: layer {i} ({name}) uses dropout (rng "
+                    "plumbing through the pipe ring is not implemented)")
+            if getattr(layer, "updater", None) is not None:
+                raise NotImplementedError(
+                    "GPipeTrainer v1: per-layer updater overrides unsupported")
+            if getattr(layer, "gradient_normalization", None) or \
+                    getattr(layer, "constraints", None):
+                raise NotImplementedError(
+                    "GPipeTrainer v1: gradient normalization / constraints "
+                    "unsupported")
+
+    # -- stage construction ------------------------------------------------
+    def _build_stages(self):
+        ref = self._ref
+        mb_shapes = []       # static input shape (sans batch) per stage
+        self._stage_layers = []
+        vecs, unravels, self._stage_lens = [], [], []
+
+        for (s, e) in self.stage_ranges:
+            stage_params = tuple(ref.params[i] for i in range(s, e))
+            vec, unravel = ravel_pytree(stage_params)
+            vec = jnp.asarray(vec, jnp.float32)
+            vecs.append(vec)
+            unravels.append(unravel)
+            self._stage_lens.append(vec.size)
+            self._stage_layers.append(tuple(ref.layers[i] for i in range(s, e)))
+            mb_shapes.append(ref.layer_input_types[s].batch_shape(1)[1:])
+
+        out_shape = ref.layer_input_types[self.head_idx].batch_shape(1)[1:]
+        self._boundary_shapes = mb_shapes + [out_shape]
+        flat_sizes = [int(np.prod(s)) for s in self._boundary_shapes]
+        self.f_max = max(flat_sizes)
+        self._in_shapes = mb_shapes
+        self._in_sizes = flat_sizes[:-1]
+        self.out_size = flat_sizes[-1]
+        self.out_shape = out_shape
+
+        l_max = max(self._stage_lens)
+        self.stacked = jnp.stack([
+            jnp.pad(v, (0, l_max - v.size)) for v in vecs
+        ])  # [S, Lmax]
+        self.stacked = jax.device_put(
+            self.stacked, NamedSharding(self.mesh, P(self.pipe_axis)))
+        self._unravels = unravels
+        self.head_params = jax.device_put(
+            ref.params[self.head_idx],
+            NamedSharding(self.mesh, P()))
+
+        # per-stage branch: [Lmax], [mb, Fmax] -> [mb, Fmax]
+        def make_branch(i):
+            unravel = unravels[i]
+            layers = self._stage_layers[i]
+            in_size, in_shape = self._in_sizes[i], self._in_shapes[i]
+            length = self._stage_lens[i]
+
+            def branch(vec, xf):
+                params = unravel(vec[:length])
+                x = xf[:, :in_size].reshape((xf.shape[0],) + tuple(in_shape))
+                x = x.astype(self._ref.dtype)
+                for layer, p in zip(layers, params):
+                    x, _ = layer.apply(p, {}, x, train=True, rng=None)
+                out = x.reshape(x.shape[0], -1).astype(jnp.float32)
+                pad = self.f_max - out.shape[1]
+                return jnp.pad(out, ((0, 0), (0, pad))) if pad else out
+
+            return branch
+
+        self._branches = [make_branch(i) for i in range(self.n_stages)]
+
+    # -- the SPMD pipelined step ------------------------------------------
+    def _stage_apply(self, vec, x, rank):
+        return lax.switch(rank, self._branches, vec, x)
+
+    def _pipelined_forward(self, stacked, x_micro):
+        # Same ring schedule as the low-level kernel (pipeline._gpipe_shard);
+        # only the stage body differs — the rank-switched heterogeneous
+        # branch dispatch.
+        from deeplearning4j_tpu.parallel.pipeline import _gpipe_shard
+
+        fn = functools.partial(
+            _gpipe_shard,
+            stage_apply=lambda vec, x: self._stage_apply(
+                vec, x, lax.axis_index(self.pipe_axis)),
+            axis_name=self.pipe_axis,
+            n_stages=self.n_stages,
+        )
+        xspec = P(None, self.data_axis)
+        return shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=(P(self.pipe_axis), xspec),
+            out_specs=xspec,
+        )(stacked, x_micro)
+
+    def _loss(self, params, x_micro, y_micro):
+        stacked, head = params
+        outs = self._pipelined_forward(stacked, x_micro)   # [M, mb, Fmax]
+        M, mb = outs.shape[0], outs.shape[1]
+        pre = outs[:, :, :self.out_size].reshape(
+            (M * mb,) + tuple(self.out_shape)).astype(self._ref.dtype)
+        y = y_micro.reshape((M * mb,) + tuple(y_micro.shape[2:]))
+        total = self.head_cfg.score(head, pre, y, mask=None, average=True)
+        # l1/l2 penalties, computed on the (replicated) stacked vectors —
+        # same terms the single-device step adds
+        for si in range(self.n_stages):
+            tree = self._unravels[si](stacked[si, :self._stage_lens[si]])
+            for layer, p in zip(self._stage_layers[si], tree):
+                total = total + layer.regularization_penalty(p)
+        return total + self.head_cfg.regularization_penalty(head)
+
+    def make_train_step(self):
+        updater = self.updater
+
+        def step(params, opt_state, it, x_micro, y_micro):
+            loss, grads = jax.value_and_grad(self._loss)(params, x_micro, y_micro)
+            upd, new_opt = updater.update(grads, opt_state, params, it)
+            new_params = jax.tree_util.tree_map(lambda p, d: p - d, params, upd)
+            return new_params, new_opt, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    # -- training API ------------------------------------------------------
+    def fit_batch(self, x, y):
+        if self._step is None:
+            self._step = self.make_train_step()
+        x, y = np.asarray(x), np.asarray(y)
+        B = x.shape[0]
+        if B % self.n_micro:
+            raise ValueError(
+                f"batch size {B} must be divisible by n_micro={self.n_micro}")
+        mb = B // self.n_micro
+        n_data = self.mesh.shape[self.data_axis]
+        if mb % n_data:
+            raise ValueError(
+                f"microbatch size {mb} (= {B}/{self.n_micro}) must be "
+                f"divisible by the '{self.data_axis}' mesh axis ({n_data})")
+        xm = jnp.asarray(x.reshape((self.n_micro, mb) + x.shape[1:]), jnp.float32)
+        # ring buffers carry FLAT activations: flatten+pad input to Fmax
+        xm = xm.reshape(self.n_micro, mb, -1)
+        pad = self.f_max - xm.shape[-1]
+        if pad:
+            xm = jnp.pad(xm, ((0, 0), (0, 0), (0, pad)))
+        ym = jnp.asarray(y.reshape((self.n_micro, mb) + y.shape[1:]))
+        (self.stacked, self.head_params), self.opt_state, loss = self._step(
+            (self.stacked, self.head_params), self.opt_state,
+            jnp.asarray(self.iteration, jnp.int32), xm, ym)
+        self.iteration += 1
+        return loss
+
+    def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None):
+        for _ in range(epochs):
+            for l in self.listeners:
+                l.on_epoch_start(self, self.epoch)
+            source = data() if callable(data) else data
+            for x, y, fm, lm in _iter_batches(source, batch_size):
+                if fm is not None or lm is not None:
+                    raise NotImplementedError("GPipeTrainer v1: masks unsupported")
+                loss = self.fit_batch(x, y)
+                if self.listeners:
+                    loss = float(loss)
+                    for l in self.listeners:
+                        l.iteration_done(self, self.iteration, loss, len(x))
+            for l in self.listeners:
+                l.on_epoch_end(self, self.epoch)
+            self.epoch += 1
+        return self
+
+    def set_listeners(self, *ls):
+        self.listeners = list(ls)
+        return self
+
+    # -- back to an ordinary model ----------------------------------------
+    def to_model(self) -> MultiLayerNetwork:
+        """Unravel the trained stage vectors into a plain MultiLayerNetwork
+        (params host-local, ready for output/evaluate/serialization)."""
+        model = MultiLayerNetwork(self.conf).init()
+        stacked = np.asarray(jax.device_get(self.stacked))
+        new_params = list(model.params)
+        for si, (s, e) in enumerate(self.stage_ranges):
+            tree = self._unravels[si](
+                jnp.asarray(stacked[si, :self._stage_lens[si]]))
+            for off, i in enumerate(range(s, e)):
+                new_params[i] = jax.tree_util.tree_map(
+                    lambda a: jnp.asarray(a, model.dtype), tree[off])
+        new_params[self.head_idx] = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(jax.device_get(a), model.dtype),
+            self.head_params)
+        model.params = tuple(new_params)
+        model.iteration = self.iteration
+        model.epoch = self.epoch
+        return model

@@ -1,0 +1,144 @@
+"""GPipeTrainer (parallel/gpipe.py): pipeline parallelism as a framework
+feature. The core contract is EQUIVALENCE: pipelined training must produce
+the same parameters as plain single-device MultiLayerNetwork.fit."""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import LeNet5
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers import BatchNorm, Conv2D, Dense, DropoutLayer, OutputLayer, Subsampling2D
+from deeplearning4j_tpu.nn.model import MultiLayerConfiguration, MultiLayerNetwork
+from deeplearning4j_tpu.parallel.gpipe import GPipeTrainer, partition_layers
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+
+
+def _mlp_conf(updater):
+    return MultiLayerConfiguration(
+        layers=(Dense(n_out=12, activation="tanh"),
+                Dense(n_out=10, activation="relu"),
+                Dense(n_out=8, activation="tanh"),
+                OutputLayer(n_out=4, activation="softmax")),
+        input_type=InputType.feed_forward(6),
+        updater=updater,
+        seed=9,
+    )
+
+
+def _data(n=16, f=6, c=4, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.rand(n, f).astype(np.float32)
+    y = np.eye(c, dtype=np.float32)[rs.randint(0, c, n)]
+    return x, y
+
+
+def _assert_params_match(piped, single, context=""):
+    assert len(piped.params) == len(single.params)
+    for i, (a, b) in enumerate(zip(piped.params, single.params)):
+        assert set(a.keys()) == set(b.keys()), f"layer {i} param keys differ"
+        for k in sorted(a):
+            np.testing.assert_allclose(
+                np.asarray(a[k]), np.asarray(b[k]), rtol=2e-4, atol=2e-5,
+                err_msg=f"layer {i} param {k} diverged {context}")
+
+
+class TestPartition:
+    def test_balanced_contiguous_cover(self):
+        ranges = partition_layers([100, 100, 100, 100], 2)
+        assert ranges == [(0, 2), (2, 4)]
+
+    def test_every_stage_nonempty_with_skewed_counts(self):
+        ranges = partition_layers([1000, 1, 1, 1], 3)
+        assert ranges[0][0] == 0 and ranges[-1][1] == 4
+        assert all(e > s for s, e in ranges)
+
+    def test_more_stages_than_layers_rejected(self):
+        with pytest.raises(ValueError):
+            partition_layers([1, 2], 3)
+
+
+class TestEquivalence:
+    @pytest.mark.parametrize("updater", [
+        {"type": "sgd", "lr": 0.05},
+        {"type": "adam", "lr": 5e-3},
+    ])
+    def test_mlp_matches_single_device(self, updater):
+        x, y = _data()
+        single = MultiLayerNetwork(_mlp_conf(updater)).init()
+        single.fit((x, y), epochs=3)
+
+        mesh = make_mesh(MeshSpec(data=2, pipe=2, model=1, seq=2))
+        tr = GPipeTrainer(_mlp_conf(updater), mesh, n_micro=4)
+        tr.fit((x, y), epochs=3)
+        _assert_params_match(tr.to_model(), single)
+
+    def test_lenet_matches_single_device(self):
+        """A REAL zoo config (conv/pool/dense, unequal boundary widths)."""
+        conf = lambda: LeNet5(height=8, width=8, channels=1, num_classes=3,
+                              updater={"type": "sgd", "lr": 0.05})
+        rs = np.random.RandomState(1)
+        x = rs.rand(8, 8, 8, 1).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 8)]
+
+        single = MultiLayerNetwork(conf()).init()
+        single.fit((x, y), epochs=2)
+
+        mesh = make_mesh(MeshSpec(data=2, pipe=2, model=1, seq=2))
+        tr = GPipeTrainer(conf(), mesh, n_micro=2)
+        tr.fit((x, y), epochs=2)
+        _assert_params_match(tr.to_model(), single, "(lenet)")
+
+    def test_l2_regularization_matches(self):
+        upd = {"type": "sgd", "lr": 0.05}
+        mk = lambda: MultiLayerConfiguration(
+            layers=(Dense(n_out=10, activation="tanh", l2=1e-2),
+                    Dense(n_out=8, activation="relu"),
+                    OutputLayer(n_out=4, activation="softmax", l2=1e-3)),
+            input_type=InputType.feed_forward(6), updater=upd, seed=4)
+        x, y = _data()
+        single = MultiLayerNetwork(mk()).init()
+        single.fit((x, y), epochs=3)
+        mesh = make_mesh(MeshSpec(data=2, pipe=2, model=1, seq=2))
+        tr = GPipeTrainer(mk(), mesh, n_micro=4)
+        tr.fit((x, y), epochs=3)
+        _assert_params_match(tr.to_model(), single, "(l2 path)")
+
+
+class TestFrameworkIntegration:
+    def test_listeners_fire(self):
+        from deeplearning4j_tpu.train.listeners import CollectScoresListener
+        x, y = _data()
+        mesh = make_mesh(MeshSpec(data=2, pipe=2, model=1, seq=2))
+        tr = GPipeTrainer(_mlp_conf({"type": "sgd", "lr": 0.05}), mesh, n_micro=4)
+        lis = CollectScoresListener()
+        tr.set_listeners(lis).fit((x, y), epochs=3)
+        assert len(lis.scores) == 3
+        assert lis.scores[-1][1] < lis.scores[0][1] * 1.5  # sane magnitudes
+
+    def test_loss_decreases(self):
+        x, y = _data(n=32)
+        mesh = make_mesh(MeshSpec(data=2, pipe=2, model=1, seq=2))
+        tr = GPipeTrainer(_mlp_conf({"type": "adam", "lr": 1e-2}), mesh, n_micro=4)
+        l0 = float(tr.fit_batch(x, y))
+        for _ in range(60):
+            l1 = float(tr.fit_batch(x, y))
+        assert l1 < l0 * 0.8
+
+    def test_stateful_layers_rejected(self):
+        conf = MultiLayerConfiguration(
+            layers=(Dense(n_out=8), BatchNorm(),
+                    OutputLayer(n_out=3, activation="softmax")),
+            input_type=InputType.feed_forward(6), seed=1)
+        mesh = make_mesh(MeshSpec(data=2, pipe=2, model=1, seq=2))
+        with pytest.raises(NotImplementedError, match="state"):
+            GPipeTrainer(conf, mesh)
+
+    def test_dropout_rejected(self):
+        conf = MultiLayerConfiguration(
+            layers=(Dense(n_out=8, dropout=0.3),
+                    OutputLayer(n_out=3, activation="softmax")),
+            input_type=InputType.feed_forward(6), seed=1)
+        mesh = make_mesh(MeshSpec(data=2, pipe=2, model=1, seq=2))
+        with pytest.raises(NotImplementedError, match="dropout"):
+            GPipeTrainer(conf, mesh)
