@@ -12,7 +12,12 @@ reference's per-pair Java thread workers.
 from deeplearning4j_tpu.graph.api import Edge, Graph, Vertex
 from deeplearning4j_tpu.graph.deepwalk import DeepWalk, GraphHuffman
 from deeplearning4j_tpu.graph.loader import GraphLoader
-from deeplearning4j_tpu.graph.walks import RandomWalkIterator, WeightedRandomWalkIterator
+from deeplearning4j_tpu.graph.node2vec import Node2Vec
+from deeplearning4j_tpu.graph.walks import (
+    Node2VecWalkIterator,
+    RandomWalkIterator,
+    WeightedRandomWalkIterator,
+)
 
 __all__ = [
     "Edge",
@@ -21,6 +26,8 @@ __all__ = [
     "DeepWalk",
     "GraphHuffman",
     "GraphLoader",
+    "Node2Vec",
+    "Node2VecWalkIterator",
     "RandomWalkIterator",
     "WeightedRandomWalkIterator",
 ]

@@ -67,6 +67,60 @@ class RandomWalkIterator:
             yield self.next()
 
 
+class Node2VecWalkIterator(RandomWalkIterator):
+    """Second-order biased walks (node2vec; reference
+    deeplearning4j-nlp-parent models/node2vec/Node2Vec.java uses these
+    semantics): from edge (prev -> cur), the next hop x is drawn with
+    unnormalized probability 1/p if x == prev (return), 1 if x is a
+    neighbor of prev (BFS-ish), 1/q otherwise (DFS-ish)."""
+
+    def __init__(self, graph: Graph, walk_length: int, p: float = 1.0,
+                 q: float = 1.0, seed: int = 12345,
+                 no_edge_handling: str = SELF_LOOP_ON_DISCONNECTED):
+        self.p = float(p)
+        self.q = float(q)
+        super().__init__(graph, walk_length, seed, no_edge_handling)
+
+    def _step2(self, cur: int, prev: int, prev_nbrs: Optional[frozenset]):
+        """One biased hop. ``prev_nbrs``: prev's neighbor set, carried over
+        from the previous step (cur's neighbors become next step's prev set —
+        avoids re-fetching/copying adjacency twice per hop on hub vertices).
+        Returns (next_vertex, cur_nbrs_set)."""
+        nbrs = self.graph.get_connected_vertex_indices(cur)
+        cur_set = frozenset(nbrs)
+        if not nbrs:
+            if self.no_edge_handling == EXCEPTION_ON_DISCONNECTED:
+                raise NoEdgesException(f"vertex {cur} is disconnected")
+            return cur, cur_set
+        if prev < 0:
+            return nbrs[self._rs.randint(len(nbrs))], cur_set
+        w = np.empty(len(nbrs), np.float64)
+        for i, x in enumerate(nbrs):
+            if x == prev:
+                w[i] = 1.0 / self.p
+            elif x in prev_nbrs:
+                w[i] = 1.0
+            else:
+                w[i] = 1.0 / self.q
+        w /= w.sum()
+        return int(nbrs[self._rs.choice(len(nbrs), p=w)]), cur_set
+
+    def next(self) -> np.ndarray:
+        if not self.has_next():
+            raise StopIteration
+        cur = int(self._order[self._pos])
+        self._pos += 1
+        walk = np.empty(self.walk_length + 1, np.int64)
+        walk[0] = cur
+        prev = -1
+        prev_nbrs: Optional[frozenset] = None
+        for i in range(1, self.walk_length + 1):
+            nxt, cur_nbrs = self._step2(cur, prev, prev_nbrs)
+            prev, cur, prev_nbrs = cur, nxt, cur_nbrs
+            walk[i] = cur
+        return walk
+
+
 class WeightedRandomWalkIterator(RandomWalkIterator):
     """Next hop drawn proportional to edge weight
     (WeightedRandomWalkIterator.java)."""

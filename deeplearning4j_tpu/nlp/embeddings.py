@@ -102,6 +102,42 @@ def _cbow_ns_step(params, context_win, win_mask, targets, negs, lr):
                                               if k not in ("syn0", "syn1neg")}}, loss
 
 
+def _dm_ns_step(params, doc_ids, context_win, win_mask, targets, negs, lr):
+    """PV-DM negative sampling (models/embeddings/learning/impl/sequence/
+    DM.java): the document vector and the window-word average JOINTLY (mean
+    over doc + context vectors) predict the center word.
+
+    doc_ids: [B] int32 (label rows of syn0); context_win: [B,W] padded,
+    win_mask: [B,W]; targets: [B]; negs: [B,K].
+    """
+    syn0, syn1 = params["syn0"], params["syn1neg"]
+    ctx = syn0[context_win]                                # [B,W,D]
+    doc = syn0[doc_ids]                                    # [B,D]
+    cnt = jnp.sum(win_mask, axis=-1, keepdims=True) + 1.0  # + the doc vector
+    h = (jnp.sum(ctx * win_mask[..., None], axis=1) + doc) / cnt
+    t = syn1[targets]
+    n = syn1[negs]
+    pos_dot = jnp.sum(h * t, axis=-1)
+    neg_dot = jnp.einsum("bd,bkd->bk", h, n)
+    loss = -jnp.mean(
+        jax.nn.log_sigmoid(pos_dot) + jnp.sum(jax.nn.log_sigmoid(-neg_dot), axis=-1)
+    )
+    gpos = jax.nn.sigmoid(pos_dot) - 1.0
+    gneg = jax.nn.sigmoid(neg_dot)
+    d_h = gpos[:, None] * t + jnp.einsum("bk,bkd->bd", gneg, n)   # [B,D]
+    d_t = gpos[:, None] * h
+    d_n = gneg[..., None] * h[:, None, :]
+    d_shared = d_h / cnt
+    d_ctx = d_shared[:, None, :] * win_mask[..., None]             # [B,W,D]
+
+    syn0 = syn0.at[context_win.reshape(-1)].add(-lr * d_ctx.reshape(-1, d_ctx.shape[-1]))
+    syn0 = syn0.at[doc_ids].add(-lr * d_shared)
+    syn1 = syn1.at[targets].add(-lr * d_t)
+    syn1 = syn1.at[negs.reshape(-1)].add(-lr * d_n.reshape(-1, d_n.shape[-1]))
+    return {"syn0": syn0, "syn1neg": syn1, **{k: v for k, v in params.items()
+                                              if k not in ("syn0", "syn1neg")}}, loss
+
+
 def _sg_hs_step(params, centers, codes, points, mask, lr):
     """Skip-gram hierarchical softmax over Huffman paths.
 
@@ -187,9 +223,11 @@ def _batched(gen, batch_size: int):
 
 
 def _batched_windows(gen, batch_size: int, max_width: int):
-    """Batch (center, [contexts]) into padded [B,W] arrays + win_mask."""
+    """Batch (center, [contexts]) — or tagged (tag, center, [contexts]) —
+    into padded [B,W] arrays + win_mask. Tagged items (the PV-DM doc id)
+    yield (tags, centers, win, mask); untagged yield (centers, win, mask)."""
 
-    def flush(centers, ctxs):
+    def flush(tags, centers, ctxs):
         B = len(centers)
         win = np.zeros((B, max_width), np.int32)
         mask = np.zeros((B, max_width), np.float32)
@@ -197,17 +235,23 @@ def _batched_windows(gen, batch_size: int, max_width: int):
             L = min(len(ctx), max_width)
             win[r, :L] = ctx[:L]
             mask[r, :L] = 1.0
-        return np.asarray(centers, np.int32), win, mask
+        out = (np.asarray(centers, np.int32), win, mask)
+        return (np.asarray(tags, np.int32),) + out if tags else out
 
-    centers, ctxs = [], []
-    for c, ctx in gen:
+    tags, centers, ctxs = [], [], []
+    for item in gen:
+        if len(item) == 3:
+            t, c, ctx = item
+            tags.append(t)
+        else:
+            c, ctx = item
         centers.append(c)
         ctxs.append(ctx)
         if len(centers) == batch_size:
-            yield flush(centers, ctxs)
-            centers, ctxs = [], []
+            yield flush(tags, centers, ctxs)
+            tags, centers, ctxs = [], [], []
     if centers:
-        yield flush(centers, ctxs)
+        yield flush(tags, centers, ctxs)
 
 
 # ---------------------------------------------------------------------------
@@ -278,7 +322,8 @@ class SequenceVectors:
     # -- training ----------------------------------------------------------
     def _jit_step(self, kind: str):
         if kind not in self._step_cache:
-            fn = {"sg_ns": _sg_ns_step, "cbow_ns": _cbow_ns_step, "sg_hs": _sg_hs_step}[kind]
+            fn = {"sg_ns": _sg_ns_step, "cbow_ns": _cbow_ns_step,
+                  "sg_hs": _sg_hs_step, "dm_ns": _dm_ns_step}[kind]
             self._step_cache[kind] = jax.jit(fn, donate_argnums=(0,))
         return self._step_cache[kind]
 
@@ -430,14 +475,20 @@ class Word2Vec(SequenceVectors):
 
 class ParagraphVectors(Word2Vec):
     """models/paragraphvectors/ParagraphVectors.java: documents get their own
-    vectors, trained DBOW-style (the label vector predicts each word of its
-    document — PV-DBOW, the reference's DBOW learning impl)."""
+    vectors. ``sequence_learning="dbow"`` (default, the reference's DBOW
+    impl: the label vector predicts each word) or ``"dm"`` (PV-DM,
+    learning/impl/sequence/DM.java: doc vector + window average predict the
+    center word)."""
 
     LABEL_PREFIX = "__label__"
 
-    def __init__(self, **kw):
+    def __init__(self, sequence_learning: str = "dbow", **kw):
         kw.setdefault("min_word_frequency", 1)
         super().__init__(**kw)
+        if sequence_learning not in ("dbow", "dm"):
+            raise ValueError(f"sequence_learning must be 'dbow' or 'dm', "
+                             f"got {sequence_learning!r}")
+        self.sequence_learning = sequence_learning
         self.labels: List[str] = []
 
     def fit_documents(self, docs: Sequence[Tuple[str, str]]) -> "ParagraphVectors":
@@ -448,8 +499,17 @@ class ParagraphVectors(Word2Vec):
         # vocab over words + labels (labels as special tokens)
         super(Word2Vec, self).build_vocab(token_seqs, special=tuple(self.labels))
         self._init_params()
-        # DBOW: every (label, word) pair is a skip-gram pair
         table = unigram_table(self.vocab)
+        if self.sequence_learning == "dm":
+            self._fit_dm(token_seqs, table)
+        else:
+            self._fit_dbow(token_seqs, table)
+        # words also train among themselves (reference trainElementsVectors)
+        super(Word2Vec, self).fit(token_seqs)
+        return self
+
+    def _fit_dbow(self, token_seqs, table):
+        # DBOW: every (label, word) pair is a skip-gram pair
         step = self._jit_step("sg_ns")
         lr = self.lr
         for ep in range(self.epochs):
@@ -473,9 +533,34 @@ class ParagraphVectors(Word2Vec):
                     jnp.asarray(lr, jnp.float32),
                 )
             lr = max(lr * 0.9, self.min_lr)
-        # words also train among themselves (reference trainElementsVectors)
-        super(Word2Vec, self).fit(token_seqs)
-        return self
+
+    def _fit_dm(self, token_seqs, table):
+        # PV-DM: (doc, window) -> center. Windows per document, batched by
+        # the shared padded-window batcher with the doc's label row as tag.
+        step = self._jit_step("dm_ns")
+        keep = subsample_probs(self.vocab, self.sample)
+        W = 2 * self.window
+        lr = self.lr
+        for ep in range(self.epochs):
+            pg = _PairGenerator(self.window, keep, self._rs)
+            items = []  # (doc_id, center, ctx)
+            for label, toks in zip(self.labels, token_seqs):
+                li = self.vocab.index_of(label)
+                idx = np.asarray(
+                    [i for i in (self.vocab.index_of(t) for t in toks) if i >= 0],
+                    np.int64)
+                for center, ctx in pg.generate_windows([idx]):
+                    items.append((li, center, ctx))
+            self._rs.shuffle(items)
+            for docs, centers, win, mask in _batched_windows(
+                    iter(items), self.batch_size, W):
+                negs = self._draw_negatives(table, (len(centers), self.negative))
+                self.params, _ = step(
+                    self.params, jnp.asarray(docs), jnp.asarray(win),
+                    jnp.asarray(mask), jnp.asarray(centers), jnp.asarray(negs),
+                    jnp.asarray(lr, jnp.float32),
+                )
+            lr = max(lr * 0.9, self.min_lr)
 
     def get_label_vector(self, label: str) -> Optional[np.ndarray]:
         return self.get_word_vector(self.LABEL_PREFIX + label)

@@ -74,7 +74,11 @@ class Yolo2OutputLayer(LayerConfig):
     def score(self, params, x, labels, mask=None, average=True, weights=None):
         """YOLOv2 composite loss (Yolo2OutputLayer.computeScore equivalent):
         coord (xy + sqrt-wh) on responsible anchors, objectness MSE toward
-        IOU (positives) / 0 (negatives), class cross-entropy on object cells."""
+        the TRUE IOU of the decoded predicted box vs ground truth
+        (positives, Yolo2OutputLayer.java:71) / 0 (negatives), class
+        cross-entropy on object cells. Anchor responsibility uses shape-IOU
+        against the anchor PRIORS (centers cancel for priors anchored at the
+        gt cell) — the true-IOU target uses decoded centers."""
         n_classes = labels.shape[-1] - 4
         B, H, W, _ = labels.shape
         A = self.n_anchors
@@ -90,16 +94,35 @@ class Yolo2OutputLayer(LayerConfig):
         gt_wh = jnp.maximum(gt_box[..., 2:4] - gt_box[..., 0:2], 1e-6)
         gt_off = gt_cxy - jnp.floor(gt_cxy)
 
-        # IOU of each anchor's predicted box vs gt (shape [B,H,W,A])
-        inter = jnp.minimum(pwh[..., 0], gt_wh[..., None, 0]) * jnp.minimum(
-            pwh[..., 1], gt_wh[..., None, 1]
-        )
-        union = pwh[..., 0] * pwh[..., 1] + (gt_wh[..., 0] * gt_wh[..., 1])[..., None] - inter
-        iou = inter / jnp.maximum(union, 1e-9)
-
-        # responsible anchor = highest-IOU anchor per object cell
-        resp = jax.nn.one_hot(jnp.argmax(iou, axis=-1), A, dtype=x.dtype)  # [B,H,W,A]
+        # responsible anchor: shape-IOU between the anchor PRIORS and the gt
+        # box (both centered) — selection only, no gradients flow through it
+        anchors = jnp.asarray(self.boxes, x.dtype)              # [A,2]
+        a_inter = (jnp.minimum(anchors[:, 0], gt_wh[..., None, 0])
+                   * jnp.minimum(anchors[:, 1], gt_wh[..., None, 1]))
+        a_union = (anchors[:, 0] * anchors[:, 1]
+                   + (gt_wh[..., 0] * gt_wh[..., 1])[..., None] - a_inter)
+        anchor_iou = a_inter / jnp.maximum(a_union, 1e-9)       # [B,H,W,A]
+        resp = jax.nn.one_hot(jnp.argmax(anchor_iou, axis=-1), A, dtype=x.dtype)
         resp = resp * obj[..., None]
+
+        # TRUE IOU of each anchor's decoded box vs gt: centers decoded as
+        # cell corner + sigmoid offset, in absolute grid units
+        cell_x = jnp.arange(W, dtype=x.dtype)[None, None, :, None]
+        cell_y = jnp.arange(H, dtype=x.dtype)[None, :, None, None]
+        pcx = cell_x + pxy[..., 0]                              # [B,H,W,A]
+        pcy = cell_y + pxy[..., 1]
+        px1, px2 = pcx - pwh[..., 0] / 2, pcx + pwh[..., 0] / 2
+        py1, py2 = pcy - pwh[..., 1] / 2, pcy + pwh[..., 1] / 2
+        ix = jnp.maximum(
+            jnp.minimum(px2, gt_box[..., None, 2]) - jnp.maximum(px1, gt_box[..., None, 0]),
+            0.0)
+        iy = jnp.maximum(
+            jnp.minimum(py2, gt_box[..., None, 3]) - jnp.maximum(py1, gt_box[..., None, 1]),
+            0.0)
+        inter = ix * iy
+        union = (pwh[..., 0] * pwh[..., 1]
+                 + (gt_wh[..., 0] * gt_wh[..., 1])[..., None] - inter)
+        true_iou = inter / jnp.maximum(union, 1e-9)             # [B,H,W,A]
 
         coord = jnp.sum(
             resp
@@ -108,7 +131,12 @@ class Yolo2OutputLayer(LayerConfig):
                 + jnp.sum((jnp.sqrt(pwh) - jnp.sqrt(gt_wh)[..., None, :]) ** 2, axis=-1)
             )
         )
-        conf_pos = jnp.sum(resp * (pconf - iou) ** 2)
+        # (pconf - IOU)^2 is kept fully differentiable: the loss is a single
+        # consistent objective (so the f64 central-difference gradcheck holds
+        # exactly), and the extra d(IOU)/d(box) term only nudges boxes toward
+        # agreement with their own confidence — darknet's stop-gradient
+        # variant is the limit where that term is dropped
+        conf_pos = jnp.sum(resp * (pconf - true_iou) ** 2)
         conf_neg = jnp.sum((1.0 - resp) * pconf**2)
         cls_loss = -jnp.sum(
             obj[..., None] * gt_cls * jnp.log(jnp.maximum(

@@ -124,8 +124,14 @@ class UIServer:
             self.storages.remove(storage)
 
     # -- rendering ---------------------------------------------------------
-    def render_html(self) -> str:
-        parts = [f"<html><head><meta charset='utf-8'><style>{_CSS}</style>"
+    def render_html(self, refresh_seconds: int = 0) -> str:
+        """``refresh_seconds > 0`` makes the page LIVE: served pages carry a
+        meta-refresh so the dashboard re-renders from storage while training
+        runs (reference module/train/TrainModule.java live updates)."""
+        refresh = (f"<meta http-equiv='refresh' content='{refresh_seconds}'>"
+                   if refresh_seconds > 0 else "")
+        parts = [f"<html><head><meta charset='utf-8'>{refresh}"
+                 f"<style>{_CSS}</style>"
                  "<title>deeplearning4j_tpu training UI</title></head><body>"
                  "<h1>Training overview</h1>"]
         for storage in self.storages:
@@ -194,7 +200,9 @@ class UIServer:
 
             def do_GET(self):
                 if self.path in ("/", "/train", "/train/overview"):
-                    body = outer.render_html().encode()
+                    # served pages are live: re-rendered per request + a
+                    # 5s meta-refresh so the browser polls while training
+                    body = outer.render_html(refresh_seconds=5).encode()
                     ctype = "text/html"
                 elif self.path == "/stats":
                     body = json.dumps([

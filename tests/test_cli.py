@@ -1,0 +1,98 @@
+"""CLI entry points (reference: ParallelWrapperMain.java, PlayUIServer.java,
+NearestNeighborsServer.java — flag-driven standalone processes)."""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+from deeplearning4j_tpu.nn.model import MultiLayerConfiguration
+
+
+def _conf_json(tmp_path):
+    conf = MultiLayerConfiguration(
+        layers=(Dense(n_out=8, activation="tanh"),
+                OutputLayer(n_out=3, activation="softmax")),
+        input_type=InputType.feed_forward(4),
+        updater={"type": "adam", "lr": 1e-2}, seed=3)
+    p = str(tmp_path / "conf.json")
+    with open(p, "w") as f:
+        f.write(conf.to_json())
+    return p
+
+
+def _npz(tmp_path, n=32):
+    rs = np.random.RandomState(0)
+    x = rs.rand(n, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, n)]
+    p = str(tmp_path / "data.npz")
+    np.savez(p, x=x, y=y)
+    return p
+
+
+class TestTrainCLI:
+    def test_end_to_end_train_and_save(self, tmp_path, capsys):
+        from deeplearning4j_tpu.train.__main__ import main
+        out = str(tmp_path / "trained.zip")
+        rc = main([_conf_json(tmp_path), "--data", _npz(tmp_path),
+                   "--epochs", "3", "--batch-size", "16", "--output", out])
+        assert rc == 0
+        assert os.path.exists(out)
+        from deeplearning4j_tpu.utils.serialization import restore_network
+        model = restore_network(out)
+        assert model.iteration > 0
+
+    def test_trained_zip_retrains(self, tmp_path):
+        """The CLI output is itself a valid input (ModelGuesser semantics)."""
+        from deeplearning4j_tpu.train.__main__ import main
+        out1 = str(tmp_path / "m1.zip")
+        out2 = str(tmp_path / "m2.zip")
+        data = _npz(tmp_path)
+        conf = _conf_json(tmp_path)
+        assert main([conf, "--data", data, "--epochs", "1", "--output", out1]) == 0
+        assert main([out1, "--data", data, "--epochs", "1", "--output", out2]) == 0
+        from deeplearning4j_tpu.utils.serialization import restore_network
+        assert restore_network(out2).iteration >= 2
+
+    def test_bad_npz_rejected(self, tmp_path):
+        from deeplearning4j_tpu.train.__main__ import main
+        bad = str(tmp_path / "bad.npz")
+        np.savez(bad, foo=np.zeros(3))
+        with pytest.raises(SystemExit, match="expected arrays"):
+            main([_conf_json(tmp_path), "--data", bad])
+
+
+class TestNNServerCLI:
+    def test_parser_and_point_loading(self, tmp_path):
+        from deeplearning4j_tpu.clustering.__main__ import build_parser
+        args = build_parser().parse_args(
+            ["--points", "p.npy", "--port", "0", "--similarity", "cosine"])
+        assert args.similarity == "cosine" and args.port == 0
+
+    def test_server_roundtrip(self, tmp_path):
+        """Same server class the CLI starts, driven over HTTP."""
+        from deeplearning4j_tpu.clustering.server import NearestNeighborsServer
+        pts = np.random.RandomState(0).rand(20, 5).astype(np.float32)
+        srv = NearestNeighborsServer(pts).start(0)
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/status", timeout=5) as r:
+                st = json.load(r)
+            assert st["points"] == 20 and st["dim"] == 5
+        finally:
+            srv.stop()
+
+
+class TestUICLI:
+    def test_parser(self):
+        from deeplearning4j_tpu.ui.__main__ import build_parser
+        args = build_parser().parse_args(["--storage", "s.jsonl", "--port", "0"])
+        assert args.port == 0 and args.storage == "s.jsonl"
+
+    def test_help_mentions_reference_surface(self):
+        from deeplearning4j_tpu.ui.__main__ import build_parser
+        assert "dashboard" in build_parser().description

@@ -127,6 +127,47 @@ class TestYolo2:
         assert iou_xyxy(np.array([0, 0, 2, 2]), np.array([0, 0, 2, 2])) == 1.0
         assert iou_xyxy(np.array([0, 0, 1, 1]), np.array([2, 2, 3, 3])) == 0.0
 
+    def test_confidence_target_is_true_iou_not_shape_iou(self):
+        """Round-3 fix (Yolo2OutputLayer.java:71 parity): two ground truths
+        with IDENTICAL shape but different centers must produce different
+        confidence targets. The old shape-only IOU scored both the same."""
+        import jax.numpy as jnp
+        layer = Yolo2OutputLayer(boxes=((1.0, 1.0),), lambda_coord=0.0,
+                                 lambda_no_obj=0.0)
+        C = 2
+        # grid logits all zero at the object cell: xy sigmoid=0.5 (center of
+        # cell), wh = e^0 * anchor = (1,1) -> decoded box (2,1,3,2)
+        x = np.zeros((1, 4, 4, 1 * (5 + C)), np.float32)
+
+        def labels(x1):
+            y = np.zeros((1, 4, 4, 4 + C), np.float32)
+            y[0, 1, 2, :4] = [x1, 1.0, x1 + 1.0, 2.0]
+            y[0, 1, 2, 4] = 1.0
+            return y
+
+        exact = float(layer.score({}, jnp.asarray(x), jnp.asarray(labels(2.0))))
+        shifted = float(layer.score({}, jnp.asarray(x), jnp.asarray(labels(2.25))))
+        # pconf = sigmoid(0) = 0.5. exact: iou=1 -> (0.5-1)^2 = 0.25
+        # shifted: inter 0.75, union 1.25, iou 0.6 -> (0.5-0.6)^2 = 0.01
+        assert abs((exact - shifted) - 0.24) < 1e-4, (exact, shifted)
+
+    def test_gradcheck(self):
+        """f64 central-difference check through the true-IOU loss."""
+        from deeplearning4j_tpu.utils.gradientcheck import check_gradients
+        C, A = 2, 2
+        conf = MultiLayerConfiguration(
+            layers=(Conv2D(n_out=A * (5 + C), kernel=(1, 1),
+                           activation="identity", convolution_mode="same"),
+                    Yolo2OutputLayer(boxes=((1.0, 1.0), (2.0, 2.0)))),
+            input_type=InputType.convolutional(4, 4, 3))
+        m = MultiLayerNetwork(conf).init()
+        rs = np.random.RandomState(3)
+        x = rs.randn(2, 4, 4, 3)
+        y = np.zeros((2, 4, 4, 4 + C), np.float32)
+        y[:, 1, 2, :4] = [2.1, 1.2, 2.9, 1.8]
+        y[:, 1, 2, 4] = 1.0
+        assert check_gradients(m, x, y, subset=8)
+
 
 class TestCenterLoss:
     def test_trains_and_centers_move(self):

@@ -1,0 +1,102 @@
+"""Round-3 NLP/graph tail: PV-DM (DM.java) and Node2Vec (Node2Vec.java)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import Graph, Node2Vec, Node2VecWalkIterator
+from deeplearning4j_tpu.nlp.embeddings import ParagraphVectors
+
+
+def _topic_docs():
+    cats = "cat kitten purr whiskers feline meow"
+    dogs = "dog puppy bark fetch canine woof"
+    docs = []
+    for i in range(6):
+        docs.append((f"{cats} {cats}", f"cat{i}"))
+        docs.append((f"{dogs} {dogs}", f"dog{i}"))
+    return docs
+
+
+class TestPVDM:
+    def test_dm_mode_trains_and_separates_topics(self):
+        pv = ParagraphVectors(sequence_learning="dm", layer_size=16,
+                              window=3, negative=4, epochs=8, seed=5,
+                              learning_rate=0.05)
+        pv.fit_documents(_topic_docs())
+
+        def sim(a, b):
+            va, vb = pv.get_label_vector(a), pv.get_label_vector(b)
+            return float(va @ vb / (np.linalg.norm(va) * np.linalg.norm(vb)))
+
+        same = sim("cat0", "cat1")
+        cross = sim("cat0", "dog1")
+        assert same > cross, (same, cross)
+
+    def test_dm_doc_vectors_exist_and_move(self):
+        pv = ParagraphVectors(sequence_learning="dm", layer_size=8,
+                              window=2, epochs=2, seed=1)
+        pv.fit_documents([("a b c a b", "d0"), ("c d e c d", "d1")])
+        v0 = pv.get_label_vector("d0")
+        assert v0 is not None and np.isfinite(v0).all()
+        assert np.linalg.norm(v0) > 0
+
+    def test_invalid_mode_rejected(self):
+        with pytest.raises(ValueError, match="dbow.*dm|dm.*dbow"):
+            ParagraphVectors(sequence_learning="pvdm")
+
+    def test_dbow_still_default(self):
+        assert ParagraphVectors().sequence_learning == "dbow"
+
+
+def _two_cliques(k=5):
+    """Two k-cliques joined by one bridge edge."""
+    g = Graph(2 * k)
+    for base in (0, k):
+        for i in range(k):
+            for j in range(i + 1, k):
+                g.add_edge(base + i, base + j)
+    g.add_edge(k - 1, k)  # bridge
+    return g
+
+
+class TestNode2Vec:
+    def test_walk_shapes_and_range(self):
+        g = _two_cliques()
+        it = Node2VecWalkIterator(g, walk_length=10, p=0.5, q=2.0, seed=0)
+        walks = list(it)
+        assert len(walks) == g.num_vertices()
+        for w in walks:
+            assert len(w) == 11
+            assert ((0 <= w) & (w < g.num_vertices())).all()
+
+    def test_high_p_discourages_backtracking(self):
+        """On a path graph, p >> 1 makes immediate returns rare vs p << 1."""
+        n = 30
+        g = Graph(n)
+        for i in range(n - 1):
+            g.add_edge(i, i + 1)
+
+        def backtrack_rate(p):
+            it = Node2VecWalkIterator(g, walk_length=20, p=p, q=1.0, seed=3)
+            back = tot = 0
+            for w in it:
+                for t in range(2, len(w)):
+                    tot += 1
+                    back += int(w[t] == w[t - 2])
+            return back / tot
+
+        assert backtrack_rate(100.0) < backtrack_rate(0.01) - 0.2
+
+    def test_embeddings_cluster_by_clique(self):
+        k = 6
+        g = _two_cliques(k)
+        n2v = Node2Vec(vector_size=16, window=2, walk_length=5,
+                       walks_per_vertex=20, p=1.0, q=2.0, epochs=5,
+                       learning_rate=0.1, seed=2).fit(g)
+        # aggregate: mean same-clique similarity must beat cross-clique
+        same = np.mean([n2v.similarity(i, j)
+                        for i in range(3) for j in range(i + 1, 3)])
+        cross = np.mean([n2v.similarity(i, k + j)
+                         for i in range(3) for j in range(1, 4)])
+        assert same > cross, (same, cross)
+        assert n2v.get_vertex_vector(3) is not None
