@@ -25,7 +25,9 @@ from deeplearning4j_tpu.datasets.fetchers import (
     CifarDataSetIterator,
     EmnistDataSetIterator,
     IrisDataSetIterator,
+    LFWDataSetIterator,
     MnistDataSetIterator,
+    SvhnDataSetIterator,
     TinyImageNetDataSetIterator,
     UciSequenceDataSetIterator,
     cache_dir,
@@ -54,6 +56,7 @@ __all__ = [
     "JointParallelDataSetIterator",
     "MnistDataSetIterator", "EmnistDataSetIterator", "IrisDataSetIterator",
     "CifarDataSetIterator", "TinyImageNetDataSetIterator",
+    "SvhnDataSetIterator", "LFWDataSetIterator",
     "UciSequenceDataSetIterator", "uci_synthetic_control", "cache_dir",
     "Normalizer", "NormalizerStandardize", "NormalizerMinMaxScaler",
     "ImagePreProcessingScaler",

@@ -311,3 +311,98 @@ class UciSequenceDataSetIterator(ListDataSetIterator):
         pick = idx[:cut] if train else idx[cut:]
         yy = np.repeat(y[pick][:, None, :], x.shape[1], axis=1)  # [N, T, C]
         super().__init__(DataSet(x[pick], yy), batch_size)
+
+
+class SvhnDataSetIterator(ListDataSetIterator):
+    """SVHN 32x32x3 digits (datasets/fetchers/SvhnDataFetcher.java): loads
+    the cropped-digits .mat files from the cache dir when scipy is
+    importable, else a deterministic synthetic surrogate."""
+
+    N_CLASSES = 10
+
+    def __init__(self, batch_size: int, train: bool = True, seed: int = 12345,
+                 num_examples: Optional[int] = None):
+        loaded = self._try_load_mat(train)
+        if loaded is not None:
+            x, y = loaded
+            self.synthetic = False
+        else:
+            n = int(os.environ.get("DL4J_TPU_SYNTH_N", 5000))
+            x, y = _synthetic_images(n, self.N_CLASSES, 32, 32, 3,
+                                     seed + (11 if train else 12))
+            self.synthetic = True
+        ds = DataSet(x.astype(np.float32) / 255.0,
+                     np.eye(self.N_CLASSES, dtype=np.float32)[y])
+        if num_examples is not None:
+            ds, _ = ds.split_test_and_train(num_examples)
+        super().__init__(ds, batch_size)
+
+    def _try_load_mat(self, train: bool):
+        path = os.path.join(cache_dir(),
+                            "train_32x32.mat" if train else "test_32x32.mat")
+        if not os.path.exists(path):
+            return None
+        try:
+            from scipy.io import loadmat
+        except ImportError:
+            return None
+        d = loadmat(path)
+        x = np.transpose(d["X"], (3, 0, 1, 2))          # HWCN -> NHWC
+        y = d["y"].ravel().astype(np.int64) % 10        # SVHN labels digit '0' as 10
+        return x, y
+
+
+class LFWDataSetIterator(ListDataSetIterator):
+    """Labeled Faces in the Wild (datasets/iterator/impl/LFWDataSetIterator.java):
+    ``lfw/<person>/<person>_NNNN.jpg`` folders from the cache dir when PIL is
+    importable, else a synthetic surrogate. ``num_labels``: keep the N most
+    frequent identities (the reference's numLabels knob)."""
+
+    def __init__(self, batch_size: int, image_shape: Tuple[int, int, int] = (64, 64, 3),
+                 num_labels: int = 10, train: bool = True, seed: int = 12345,
+                 num_examples: Optional[int] = None):
+        h, w, c = image_shape
+        self.num_labels = num_labels
+        loaded = self._try_load_folder(h, w, num_labels)
+        if loaded is not None:
+            x, y = loaded
+            self.synthetic = False
+        else:
+            n = int(os.environ.get("DL4J_TPU_SYNTH_N", 1000))
+            x, y = _synthetic_images(n, num_labels, h, w, c,
+                                     seed + (13 if train else 14))
+            self.synthetic = True
+        ds = DataSet(x.astype(np.float32) / 255.0,
+                     np.eye(num_labels, dtype=np.float32)[y])
+        if num_examples is not None:
+            ds, _ = ds.split_test_and_train(num_examples)
+        super().__init__(ds, batch_size)
+
+    def _try_load_folder(self, h: int, w: int, num_labels: int):
+        root = os.path.join(cache_dir(), "lfw")
+        if not os.path.isdir(root):
+            return None
+        try:
+            from PIL import Image
+        except ImportError:
+            return None
+        def n_images(d):
+            return sum(1 for f in os.listdir(os.path.join(root, d))
+                       if f.lower().endswith((".jpg", ".jpeg", ".png")))
+
+        people = sorted(
+            (d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+             and n_images(d) > 0),
+            key=lambda d: -n_images(d))[:num_labels]
+        xs, ys = [], []
+        for li, person in enumerate(people):
+            pdir = os.path.join(root, person)
+            for f in sorted(os.listdir(pdir)):
+                if not f.lower().endswith((".jpg", ".jpeg", ".png")):
+                    continue
+                img = Image.open(os.path.join(pdir, f)).convert("RGB").resize((w, h))
+                xs.append(np.asarray(img, np.uint8))
+                ys.append(li)
+        if not xs:
+            return None
+        return np.stack(xs), np.asarray(ys, np.int64)

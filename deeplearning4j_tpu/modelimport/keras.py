@@ -115,10 +115,39 @@ def _loss_for(activation: str) -> str:
     return {"softmax": "mcxent", "sigmoid": "xent"}.get(activation, "mse")
 
 
+def _keras1_normalize(class_name: str, cfg: dict) -> dict:
+    """Accept the Keras-1 config dialect (reference
+    config/Keras1LayerConfiguration.java): legacy field names are mapped to
+    their Keras-2 equivalents before conversion."""
+    cfg = dict(cfg)
+    if "output_dim" in cfg:
+        cfg.setdefault("units", cfg["output_dim"])
+    if "nb_filter" in cfg:
+        cfg.setdefault("filters", cfg["nb_filter"])
+    if "nb_row" in cfg:
+        cfg.setdefault("kernel_size", [cfg["nb_row"], cfg.get("nb_col", cfg["nb_row"])])
+    if "filter_length" in cfg:
+        cfg.setdefault("kernel_size", cfg["filter_length"])
+    if "subsample" in cfg:
+        cfg.setdefault("strides", cfg["subsample"])
+    if "subsample_length" in cfg:
+        cfg.setdefault("strides", cfg["subsample_length"])
+    if "border_mode" in cfg:
+        cfg.setdefault("padding", cfg["border_mode"])
+    if "pool_length" in cfg:
+        cfg.setdefault("pool_size", cfg["pool_length"])
+    if "stride" in cfg and "strides" not in cfg:
+        cfg.setdefault("strides", cfg["stride"])
+    if class_name == "Dropout" and "p" in cfg:
+        cfg.setdefault("rate", cfg["p"])
+    return cfg
+
+
 def _convert_layer(class_name: str, cfg: dict, *, as_output: bool = False,
                    recurrent: bool = False):
     """Returns a LayerConfig, or None for structural layers (Flatten,
     InputLayer) that this framework expresses as preprocessors."""
+    cfg = _keras1_normalize(class_name, cfg)
     if class_name in ("InputLayer", "Flatten"):
         return None
     if class_name == "Dense":
@@ -221,6 +250,84 @@ def _convert_layer(class_name: str, cfg: dict, *, as_output: bool = False,
     if class_name == "SimpleRNN":
         return SimpleRnn(n_out=int(cfg["units"]),
                          activation=_act(cfg.get("activation", "tanh")))
+    if class_name == "Conv2DTranspose":
+        mode, pad = _conv_mode(cfg.get("padding", "valid"))
+        from deeplearning4j_tpu.nn.layers import Deconv2D
+
+        if cfg.get("output_padding") not in (None, 0, [0, 0], (0, 0)):
+            raise UnsupportedKerasConfigurationError(
+                f"Conv2DTranspose output_padding {cfg['output_padding']!r}")
+        if _pair(cfg.get("dilation_rate", 1)) != (1, 1):
+            raise UnsupportedKerasConfigurationError(
+                f"Conv2DTranspose dilation_rate {cfg['dilation_rate']!r}")
+        return Deconv2D(
+            n_out=int(cfg["filters"]), kernel=_pair(cfg.get("kernel_size", 3)),
+            stride=_pair(cfg.get("strides", 1)),
+            convolution_mode=mode, padding=pad,
+            activation=_act(cfg.get("activation")),
+            has_bias=bool(cfg.get("use_bias", True)),
+        )
+    if class_name == "Cropping2D":
+        from deeplearning4j_tpu.nn.layers import Cropping2D
+
+        c = cfg.get("cropping", 0)
+        if isinstance(c, (list, tuple)) and c and isinstance(c[0], (list, tuple)):
+            crop = (int(c[0][0]), int(c[0][1]), int(c[1][0]), int(c[1][1]))
+        else:
+            ch, cw = _pair(c)
+            crop = (ch, ch, cw, cw)
+        return Cropping2D(crop=crop)
+    if class_name == "LeakyReLU":
+        from deeplearning4j_tpu.nn.layers import LeakyReLULayer
+
+        alpha = cfg.get("negative_slope", cfg.get("alpha", 0.3))
+        return LeakyReLULayer(alpha=float(alpha))
+    if class_name == "ELU":
+        from deeplearning4j_tpu.nn.layers import ELULayer
+
+        return ELULayer(alpha=float(cfg.get("alpha", 1.0)))
+    if class_name == "ThresholdedReLU":
+        from deeplearning4j_tpu.nn.layers import ThresholdedReLULayer
+
+        return ThresholdedReLULayer(theta=float(cfg.get("theta", 1.0)))
+    if class_name == "PReLU":
+        from deeplearning4j_tpu.nn.layers import PReLU
+
+        if cfg.get("shared_axes"):
+            raise UnsupportedKerasConfigurationError("PReLU shared_axes")
+        return PReLU()
+    if class_name == "Permute":
+        from deeplearning4j_tpu.nn.layers import Permute
+
+        return Permute(dims=tuple(int(d) for d in cfg["dims"]))
+    if class_name == "RepeatVector":
+        from deeplearning4j_tpu.nn.layers import RepeatVector
+
+        return RepeatVector(n=int(cfg["n"]))
+    if class_name == "Bidirectional":
+        from deeplearning4j_tpu.nn.layers import Bidirectional
+
+        inner_cfg = cfg["layer"]
+        inner = _convert_layer(inner_cfg["class_name"],
+                               inner_cfg.get("config", {}))
+        mode = {"concat": "concat", "sum": "add", "ave": "average",
+                "mul": "mul"}.get(cfg.get("merge_mode", "concat"))
+        if mode is None:
+            raise UnsupportedKerasConfigurationError(
+                f"Bidirectional merge_mode {cfg.get('merge_mode')!r}")
+        bidir = Bidirectional(rnn=inner, mode=mode)
+        if not inner_cfg.get("config", {}).get("return_sequences", False):
+            # Keras return_sequences=False: fwd LAST step ++ bwd FINAL state
+            # (= step 0 after the flip-back) — a plain LastTimeStep would be
+            # wrong for the backward half
+            if mode != "concat":
+                raise UnsupportedKerasConfigurationError(
+                    "Bidirectional(return_sequences=False) with merge_mode "
+                    f"{cfg.get('merge_mode')!r}")
+            from deeplearning4j_tpu.nn.layers import BidirectionalLastTimeStep
+
+            return BidirectionalLastTimeStep(rnn=bidir)
+        return bidir
     raise UnsupportedKerasConfigurationError(f"Keras layer {class_name!r}")
 
 
@@ -283,6 +390,25 @@ def _set_weights(layer_conf, keras_weights: List[np.ndarray], params: dict,
         p["gamma"] = jnp.asarray(w[0])
         p["beta"] = jnp.asarray(w[1])
         s = {"mean": jnp.asarray(w[2]), "var": jnp.asarray(w[3])}
+    elif t == "Deconv2D":
+        # Keras Conv2DTranspose kernel is (kh, kw, OUT, IN) with
+        # gradient-of-conv semantics; lax.conv_transpose with HWIO
+        # (transpose_kernel=False) consumes the kernel directly, so the
+        # equivalent native kernel is the spatially-FLIPPED transpose
+        k = w[0]
+        p["W"] = jnp.asarray(np.flip(k, axis=(0, 1)).transpose(0, 1, 3, 2))
+        if len(w) > 1:
+            p["b"] = jnp.asarray(w[1])
+    elif t == "PReLU":
+        p["alpha"] = jnp.asarray(w[0])
+    elif t == "Bidirectional":
+        if len(w) != 6:
+            raise UnsupportedKerasConfigurationError(
+                f"Bidirectional expects 6 weight arrays, got {len(w)}")
+        p["fwd"] = {"Wx": jnp.asarray(w[0]), "Wh": jnp.asarray(w[1]),
+                    "b": jnp.asarray(w[2])}
+        p["bwd"] = {"Wx": jnp.asarray(w[3]), "Wh": jnp.asarray(w[4]),
+                    "b": jnp.asarray(w[5])}
     elif t in ("LSTM", "SimpleRnn"):
         p["Wx"] = jnp.asarray(w[0])
         p["Wh"] = jnp.asarray(w[1])
@@ -364,20 +490,42 @@ def _sequential_from_config(model_config: dict) -> Tuple[MultiLayerConfiguration
     input_type = _keras_input_type(shape, first_real)
 
     # a net is recurrent at the output if the LAST rnn layer returns sequences
-    recurrent_out = any(
-        lc["class_name"] in _RETURNS_SEQUENCES and lc["config"].get("return_sequences")
-        for lc in layers_cfg[-3:]
-    )
+    def _returns_seq(lc):
+        if lc["class_name"] in _RETURNS_SEQUENCES:
+            return lc["config"].get("return_sequences")
+        if lc["class_name"] == "Bidirectional":
+            return lc["config"].get("layer", {}).get("config", {}).get(
+                "return_sequences")
+        return False
+
+    recurrent_out = any(_returns_seq(lc) for lc in layers_cfg[-3:])
 
     our_layers: List = []
     names: List[Optional[str]] = []
+    _structural = ("InputLayer", "Flatten", "Dropout", "Activation",
+                   "LeakyReLU", "ELU", "ThresholdedReLU", "PReLU",
+                   "Cropping2D", "Permute", "RepeatVector")
     last_idx = max(
         i for i, lc in enumerate(layers_cfg)
-        if lc["class_name"] not in ("InputLayer", "Flatten", "Dropout", "Activation")
+        if lc["class_name"] not in _structural
     )
+    cur_it = input_type
     for i, lc in enumerate(layers_cfg):
         cn = lc["class_name"]
         cfg = lc.get("config", {})
+        if cn == "Flatten" and cur_it.kind == "recurrent":
+            # our Dense consumes [B,T,F] natively, so no auto-preprocessor
+            # flattens timesteps — honor Keras's explicit Flatten with a
+            # Reshape to [B, T*F]
+            from deeplearning4j_tpu.nn.preprocessors import Reshape
+
+            t = cur_it.timesteps or 1
+            conv = Reshape(shape=(int(t * cur_it.size),))
+            our_layers.append(conv)
+            # no names entry: the weight-pairing loop skips preprocessor-
+            # module layers without consuming a name
+            cur_it = conv.output_type(cur_it)
+            continue
         conv = _convert_layer(cn, cfg, as_output=(i == last_idx and cn == "Dense"),
                               recurrent=recurrent_out)
         if conv is None:
@@ -390,6 +538,10 @@ def _sequential_from_config(model_config: dict) -> Tuple[MultiLayerConfiguration
             conv = LastTimeStep(rnn=conv)
         our_layers.append(conv)
         names.append(cfg.get("name", lc.get("name")))
+        try:
+            cur_it = conv.output_type(cur_it)
+        except Exception:
+            pass  # shape tracking is best-effort; MLN resolution re-derives
     conf = MultiLayerConfiguration(layers=tuple(our_layers), input_type=input_type)
     return conf, names
 
@@ -428,7 +580,8 @@ class KerasModelImport:
             j += 1
             # LastTimeStep.init delegates to the wrapped rnn, so its params
             # dict IS the inner layer's — map weights against the inner conf
-            target = layer.rnn if type(layer).__name__ == "LastTimeStep" else layer
+            target = layer.rnn if type(layer).__name__ in (
+                "LastTimeStep", "BidirectionalLastTimeStep") else layer
             if name in weights:
                 new_params[i], new_state[i] = _set_weights(
                     target, weights[name], new_params[i], new_state[i]

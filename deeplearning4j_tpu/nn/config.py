@@ -175,6 +175,13 @@ class LayerConfig:
         return mask
 
     # -- helpers -----------------------------------------------------------
+    def nested_param_layers(self) -> dict:
+        """Sub-layer configs owning nested param-dict subtrees, keyed by the
+        subtree name (e.g. TransformerBlock's 'attn' params belong to its
+        MultiHeadAttention). TP sharding rules resolve nested params through
+        this hook rather than guessing from subtree names."""
+        return {}
+
     def activation_fn(self):
         return activations.get(getattr(self, "activation", "identity"))
 

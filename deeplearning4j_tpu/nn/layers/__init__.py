@@ -10,16 +10,23 @@ from deeplearning4j_tpu.nn.layers.core import (
     AutoEncoder,
     Dense,
     DropoutLayer,
+    ELULayer,
     Embedding,
     EmbeddingSequence,
     GaussianDropout,
     GaussianNoise,
+    LeakyReLULayer,
     LossLayer,
     OutputLayer,
+    Permute,
+    PReLU,
+    RepeatVector,
+    ThresholdedReLULayer,
 )
 from deeplearning4j_tpu.nn.layers.convolution import (
     Conv1D,
     Conv2D,
+    Cropping2D,
     Deconv2D,
     DepthwiseConv2D,
     SeparableConv2D,
@@ -51,6 +58,7 @@ from deeplearning4j_tpu.nn.layers.custom import (
 from deeplearning4j_tpu.nn.layers.pooling import GlobalPooling
 from deeplearning4j_tpu.nn.layers.recurrent import (
     Bidirectional,
+    BidirectionalLastTimeStep,
     GravesLSTM,
     LastTimeStep,
     LSTM,

@@ -152,6 +152,9 @@ class TransformerBlock(LayerConfig):
             weight_init=self.weight_init,
         )
 
+    def nested_param_layers(self) -> dict:
+        return {"attn": self._mha()}
+
     def init(self, key, input_type, dtype=jnp.float32):
         C = input_type.size
         F = self.ffn_mult * C

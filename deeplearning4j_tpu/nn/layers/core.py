@@ -112,6 +112,95 @@ class ActivationLayer(LayerConfig):
         return self.activation_fn()(x), state
 
 
+@register_layer("leaky_relu_layer")
+@dataclass
+class LeakyReLULayer(LayerConfig):
+    """Parameterized leaky ReLU (Keras LeakyReLU / nd4j ActivationLReLU with
+    a configurable slope — the registry 'leakyrelu' is fixed at 0.01)."""
+
+    alpha: float = 0.3
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return jax.nn.leaky_relu(x, negative_slope=self.alpha), state
+
+
+@register_layer("elu_layer")
+@dataclass
+class ELULayer(LayerConfig):
+    """Parameterized ELU (Keras ELU / nd4j ActivationELU(alpha))."""
+
+    alpha: float = 1.0
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return jnp.where(x > 0, x, self.alpha * (jnp.exp(x) - 1.0)), state
+
+
+@register_layer("thresholded_relu_layer")
+@dataclass
+class ThresholdedReLULayer(LayerConfig):
+    """Keras ThresholdedReLU: x if x > theta else 0."""
+
+    theta: float = 1.0
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return jnp.where(x > self.theta, x, 0.0), state
+
+
+@register_layer("prelu")
+@dataclass
+class PReLU(LayerConfig):
+    """PReLU with LEARNED negative slope (PReLULayer.java; Keras PReLU
+    default: one alpha per non-batch element, initialized to zero)."""
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        shape = input_type.batch_shape(1)[1:]
+        return {"alpha": jnp.zeros(shape, dtype)}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        a = params["alpha"]
+        return jnp.where(x >= 0, x, a * x), state
+
+
+@register_layer("permute")
+@dataclass
+class Permute(LayerConfig):
+    """Permute the non-batch axes (Keras Permute; DL4J PermutePreprocessor).
+    ``dims``: 1-based permutation of the non-batch axes, Keras-style."""
+
+    dims: Any = (1,)
+
+    def _axes(self):
+        return (0,) + tuple(int(d) for d in self.dims)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        shape = input_type.batch_shape(1)[1:]
+        new = tuple(shape[d - 1] for d in self.dims)
+        if len(new) == 1:
+            return InputType.feed_forward(new[0])
+        if len(new) == 2:
+            return InputType.recurrent(new[1], new[0])
+        if len(new) == 3:
+            return InputType.convolutional(new[0], new[1], new[2])
+        raise ValueError(f"Permute: unsupported rank {len(new)}")
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return jnp.transpose(x, self._axes()), state
+
+
+@register_layer("repeat_vector")
+@dataclass
+class RepeatVector(LayerConfig):
+    """[B,F] -> [B,n,F] (RepeatVector.java / Keras RepeatVector)."""
+
+    n: int = 1
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(input_type.flat_size(), self.n)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return jnp.repeat(x[:, None, :], self.n, axis=1), state
+
+
 @register_layer("dropout")
 @dataclass
 class DropoutLayer(LayerConfig):

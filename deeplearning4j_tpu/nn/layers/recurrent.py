@@ -222,6 +222,9 @@ class Bidirectional(LayerConfig):
             "bwd": self.rnn.init(kb, input_type, dtype),
         }
 
+    def nested_param_layers(self) -> dict:
+        return {"fwd": self.rnn, "bwd": self.rnn}
+
     def regularization_penalty(self, params):
         pen = super().regularization_penalty(params)
         return pen + self.rnn.regularization_penalty(params["fwd"]) + \
@@ -286,6 +289,41 @@ class LastTimeStep(LayerConfig):
             idx = (T - 1 - jnp.argmax(rev, axis=1)).astype(jnp.int32)
             out = jnp.take_along_axis(y, idx[:, None, None], axis=1)[:, 0, :]
         return out, state
+
+    def propagate_mask(self, mask, input_type):
+        return None
+
+
+@register_layer("bidir_last_time_step")
+@dataclass
+class BidirectionalLastTimeStep(LayerConfig):
+    """Keras ``Bidirectional(rnn, return_sequences=False)`` semantics over a
+    wrapped :class:`Bidirectional` (concat mode): the forward half's LAST
+    step concatenated with the backward half's step 0 — which is the
+    backward RNN's final state, since Bidirectional flips the backward
+    output back to input time order. A plain LastTimeStep would wrongly
+    take the backward half at t=T-1 (one step of context)."""
+
+    rnn: Optional[LayerConfig] = None  # a Bidirectional, mode="concat"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        inner = self.rnn.output_type(input_type)
+        return InputType.feed_forward(inner.size)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        if getattr(self.rnn, "mode", "concat") != "concat":
+            raise ValueError(
+                "BidirectionalLastTimeStep requires mode='concat' (merged "
+                "fwd/bwd halves are not separable for other modes)")
+        return self.rnn.init(key, input_type, dtype)
+
+    def regularization_penalty(self, params):
+        return super().regularization_penalty(params) + self.rnn.regularization_penalty(params)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        y, _ = self.rnn.apply(params, {}, x, train=train, rng=rng, mask=mask)
+        H = y.shape[-1] // 2
+        return jnp.concatenate([y[:, -1, :H], y[:, 0, H:]], axis=-1), state
 
     def propagate_mask(self, mask, input_type):
         return None

@@ -24,8 +24,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.parallel.context import use_mesh
 
+# Dense/output weight matrices at or above this element count shard
+# column-wise over the model axis; smaller matrices stay replicated — below
+# roughly this size the inserted collective + partial-matmul launch overhead
+# outweighs the memory/FLOP split on current ICI. Tunable per call via
+# tp_param_shardings(dense_shard_min_elems=...).
+TP_DENSE_SHARD_MIN_ELEMS = 1 << 16
 
-def _spec_for(layer, pname: str, value, model_axis: str) -> P:
+
+def _spec_for(layer, pname: str, value, model_axis: str,
+              dense_shard_min_elems: int = TP_DENSE_SHARD_MIN_ELEMS) -> P:
     """TP PartitionSpec for one param of one layer (replicated fallback)."""
     t = getattr(layer, "_type_name", "")
     if t == "multi_head_attention":
@@ -47,30 +55,52 @@ def _spec_for(layer, pname: str, value, model_axis: str) -> P:
         if pname in ("Wi", "bi", "Wo", "bo"):
             return P(model_axis)
         return P()
-    if t in ("dense", "output") and pname == "W" and np.prod(value.shape) >= 1 << 16:
+    if t in ("dense", "output") and pname == "W" \
+            and np.prod(value.shape) >= dense_shard_min_elems:
         return P(None, model_axis)  # shard big FF matrices column-wise
     if t in ("embedding", "embedding_sequence") and pname == "W":
         return P(None, model_axis)  # shard embedding features
     return P()
 
 
-def tp_param_shardings(model, mesh: Mesh, model_axis: str = "model"):
-    """Per-param NamedShardings for a MultiLayerNetwork's params pytree."""
+def tp_param_shardings(model, mesh: Mesh, model_axis: str = "model",
+                       dense_shard_min_elems: int = TP_DENSE_SHARD_MIN_ELEMS):
+    """Per-param NamedShardings for a MultiLayerNetwork's params pytree.
+
+    Every sharded dimension is VALIDATED against the mesh axis size up
+    front, so a bad config (e.g. MixtureOfExperts whose n_experts does not
+    divide the model axis) fails with a named error instead of a cryptic
+    GSPMD one at compile time."""
 
     def layer_specs(layer, params):
         def walk(sub, owner):
             out = {}
             for name, v in sub.items():
                 if isinstance(v, dict):
-                    # nested block (e.g. TransformerBlock."attn" is MHA params)
-                    inner_owner = owner
-                    if name == "attn":
-                        from deeplearning4j_tpu.nn.layers.attention import MultiHeadAttention
-
-                        inner_owner = MultiHeadAttention()
+                    # nested param subtree: the OWNING config declares which
+                    # sub-layer the params belong to (nested_param_layers) —
+                    # no name-based guessing
+                    inner_owner = owner.nested_param_layers().get(name, owner)
                     out[name] = walk(v, inner_owner)
                 else:
-                    out[name] = NamedSharding(mesh, _spec_for(owner, name, v, model_axis))
+                    spec = _spec_for(owner, name, v, model_axis,
+                                     dense_shard_min_elems)
+                    # Hard-validate only the MoE expert axis: an uneven
+                    # expert split silently changes routing capacity. Other
+                    # uneven shardings are legal — GSPMD pads them under jit.
+                    if getattr(owner, "_type_name", "") == "mixture_of_experts":
+                        for dim, ax in enumerate(spec):
+                            if ax is None:
+                                continue
+                            size, n = v.shape[dim], mesh.shape[ax]
+                            if size % n:
+                                raise ValueError(
+                                    f"TP sharding: {type(owner).__name__}."
+                                    f"{name} dim {dim} (size {size}) is not "
+                                    f"divisible by mesh axis '{ax}' ({n}) — "
+                                    "make n_experts a multiple of the "
+                                    f"'{ax}' axis")
+                    out[name] = NamedSharding(mesh, spec)
             return out
 
         return walk(params, layer)

@@ -1,0 +1,103 @@
+"""Generate the round-3 Keras golden fixtures (run once; outputs committed).
+
+Each fixture is a genuine tf.keras model saved as legacy HDF5 plus an
+``*_io.npz`` with a random input batch and the model's own predictions —
+the import tests assert forward equivalence against these.
+
+    python tests/fixtures/make_keras_fixtures_r3.py
+"""
+
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    import tensorflow as tf
+    from tensorflow import keras
+    from tensorflow.keras import layers as L
+
+    rs = np.random.RandomState(0)
+
+    def save(model, name, x):
+        y = model.predict(x, verbose=0)
+        model.save(os.path.join(HERE, f"{name}.h5"))
+        np.savez(os.path.join(HERE, f"{name}_io.npz"), x=x, y=y)
+        print(name, x.shape, "->", y.shape)
+
+    # 1. Conv2DTranspose + Cropping2D
+    m = keras.Sequential([
+        keras.Input((8, 8, 2)),
+        L.Conv2D(4, 3, padding="same", activation="relu"),
+        L.Conv2DTranspose(3, 3, strides=2, padding="valid"),
+        L.Cropping2D(((1, 0), (0, 1))),
+        L.Flatten(),
+        L.Dense(5, activation="softmax"),
+    ])
+    save(m, "keras_deconv", rs.rand(4, 8, 8, 2).astype(np.float32))
+
+    # 2. advanced activations (LeakyReLU / PReLU / ELU)
+    m = keras.Sequential([
+        keras.Input((10,)),
+        L.Dense(8),
+        L.LeakyReLU(negative_slope=0.2),
+        L.Dense(8),
+        L.PReLU(),
+        L.Dense(6),
+        L.ELU(alpha=0.7),
+        L.Dense(4, activation="softmax"),
+    ])
+    # nonzero PReLU alphas so the mapping is actually exercised
+    for lyr in m.layers:
+        if isinstance(lyr, L.PReLU):
+            lyr.set_weights([rs.rand(*lyr.get_weights()[0].shape)
+                             .astype(np.float32) * 0.5])
+    save(m, "keras_advact", rs.rand(4, 10).astype(np.float32))
+
+    # 3. Permute + RepeatVector
+    m = keras.Sequential([
+        keras.Input((6,)),
+        L.Dense(4, activation="relu"),
+        L.RepeatVector(3),
+        L.Permute((2, 1)),
+        L.Flatten(),
+        L.Dense(3, activation="softmax"),
+    ])
+    save(m, "keras_repeat_permute", rs.rand(4, 6).astype(np.float32))
+
+    # 4. Bidirectional(LSTM) + MaxPooling1D + GlobalMaxPooling1D
+    m = keras.Sequential([
+        keras.Input((8, 5)),
+        L.Bidirectional(L.LSTM(6, return_sequences=True)),
+        L.MaxPooling1D(2),
+        L.GlobalMaxPooling1D(),
+        L.Dense(3, activation="softmax"),
+    ])
+    save(m, "keras_bilstm", rs.rand(4, 8, 5).astype(np.float32))
+
+    make_bilstm_vec()
+
+
+def make_bilstm_vec():
+    """Bidirectional(return_sequences=False) classifier head fixture."""
+    import numpy as np
+    from tensorflow import keras
+    from tensorflow.keras import layers as L
+
+    rs = np.random.RandomState(7)
+    m = keras.Sequential([
+        keras.Input((8, 5)),
+        L.Bidirectional(L.LSTM(6)),
+        L.Dense(3, activation="softmax"),
+    ])
+    x = rs.rand(4, 8, 5).astype(np.float32)
+    y = m.predict(x, verbose=0)
+    m.save(os.path.join(HERE, "keras_bilstm_vec.h5"))
+    np.savez(os.path.join(HERE, "keras_bilstm_vec_io.npz"), x=x, y=y)
+    print("keras_bilstm_vec", x.shape, "->", y.shape)
+
+
+if __name__ == "__main__":
+    main()

@@ -211,3 +211,29 @@ class TestRecordReaders:
         p.write_text("a,b\n1,2\n3,4")
         arr = CSVRecordReader(skip_lines=1).read(str(p))
         assert arr.shape == (2, 2)
+
+
+class TestSvhnLfw:
+    def test_svhn_shapes(self):
+        from deeplearning4j_tpu.datasets import SvhnDataSetIterator
+        import os
+        os.environ["DL4J_TPU_SYNTH_N"] = "64"
+        try:
+            it = SvhnDataSetIterator(batch_size=16)
+            x, y, _, _ = next(iter(it))
+            assert x.shape == (16, 32, 32, 3) and y.shape == (16, 10)
+            assert 0.0 <= float(x.min()) and float(x.max()) <= 1.0
+        finally:
+            del os.environ["DL4J_TPU_SYNTH_N"]
+
+    def test_lfw_shapes_and_labels(self):
+        from deeplearning4j_tpu.datasets import LFWDataSetIterator
+        import os
+        os.environ["DL4J_TPU_SYNTH_N"] = "48"
+        try:
+            it = LFWDataSetIterator(batch_size=12, image_shape=(32, 32, 3),
+                                    num_labels=6)
+            x, y, _, _ = next(iter(it))
+            assert x.shape == (12, 32, 32, 3) and y.shape == (12, 6)
+        finally:
+            del os.environ["DL4J_TPU_SYNTH_N"]

@@ -1,0 +1,142 @@
+"""Round-3 Keras importer breadth (VERDICT #6): Conv2DTranspose, Cropping2D,
+advanced activations, Permute/RepeatVector, Bidirectional(LSTM), pooling
+variants — golden-fixture forward equivalence — plus the Keras-1 config
+dialect (config/Keras1LayerConfiguration.java parity)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+
+FIX = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _golden(name, rtol=1e-4, atol=1e-5):
+    model = KerasModelImport.import_keras_sequential_model_and_weights(
+        os.path.join(FIX, f"{name}.h5"))
+    io = np.load(os.path.join(FIX, f"{name}_io.npz"))
+    got = np.asarray(model.output(io["x"]))
+    np.testing.assert_allclose(got, io["y"], rtol=rtol, atol=atol)
+    return model
+
+
+class TestGoldenFixtures:
+    def test_deconv_cropping(self):
+        _golden("keras_deconv")
+
+    def test_advanced_activations(self):
+        _golden("keras_advact")
+
+    def test_repeat_permute(self):
+        _golden("keras_repeat_permute")
+
+    def test_bidirectional_lstm_pooling(self):
+        _golden("keras_bilstm")
+
+
+class TestKeras1Dialect:
+    """Hand-written Keras-1 JSON (the 1.x field names: output_dim,
+    nb_filter/nb_row/nb_col, subsample, border_mode, config as a LIST)."""
+
+    def _k1_json(self):
+        return json.dumps({
+            "class_name": "Sequential",
+            "config": [
+                {"class_name": "Convolution2D", "config": {
+                    "batch_input_shape": [None, 6, 6, 1],
+                    "nb_filter": 3, "nb_row": 3, "nb_col": 3,
+                    "subsample": [1, 1], "border_mode": "valid",
+                    "activation": "relu", "name": "conv"}},
+                {"class_name": "MaxPooling2D", "config": {
+                    "pool_size": [2, 2], "stride": [2, 2],
+                    "border_mode": "valid", "name": "pool"}},
+                {"class_name": "Flatten", "config": {"name": "flat"}},
+                {"class_name": "Dense", "config": {
+                    "output_dim": 4, "activation": "softmax", "name": "out"}},
+            ],
+        })
+
+    def test_keras1_config_imports(self):
+        conf = KerasModelImport.import_keras_sequential_configuration(self._k1_json())
+        from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+        m = MultiLayerNetwork(conf).init()
+        out = np.asarray(m.output(np.random.RandomState(0)
+                                  .rand(2, 6, 6, 1).astype(np.float32)))
+        assert out.shape == (2, 4)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+
+    def test_keras1_dropout_p(self):
+        conf = KerasModelImport.import_keras_sequential_configuration(json.dumps({
+            "class_name": "Sequential",
+            "config": [
+                {"class_name": "Dense", "config": {
+                    "batch_input_shape": [None, 4], "output_dim": 8,
+                    "activation": "tanh", "name": "d0"}},
+                {"class_name": "Dropout", "config": {"p": 0.25, "name": "dr"}},
+                {"class_name": "Dense", "config": {
+                    "output_dim": 2, "activation": "softmax", "name": "out"}},
+            ],
+        }))
+        from deeplearning4j_tpu.nn.layers import DropoutLayer
+        drops = [l for l in conf.layers if isinstance(l, DropoutLayer)]
+        assert drops and abs(drops[0].dropout - 0.25) < 1e-9
+
+
+class TestNewLayerConfigs:
+    def test_serde_roundtrip(self):
+        from deeplearning4j_tpu.nn.config import LayerConfig
+        from deeplearning4j_tpu.nn.layers import (
+            Cropping2D, ELULayer, LeakyReLULayer, Permute, PReLU,
+            RepeatVector, ThresholdedReLULayer)
+        for cfg in (Cropping2D(crop=(1, 0, 0, 1)), ELULayer(alpha=0.7),
+                    LeakyReLULayer(alpha=0.2), Permute(dims=(2, 1)),
+                    PReLU(), RepeatVector(n=3),
+                    ThresholdedReLULayer(theta=0.3)):
+            assert LayerConfig.from_json(cfg.to_json()) == cfg
+
+    def test_thresholded_relu_semantics(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.layers import ThresholdedReLULayer
+        y, _ = ThresholdedReLULayer(theta=0.5).apply(
+            {}, {}, jnp.asarray([-1.0, 0.3, 0.5, 0.9]))
+        np.testing.assert_allclose(np.asarray(y), [0.0, 0.0, 0.0, 0.9])
+
+    def test_prelu_gradcheck(self):
+        from deeplearning4j_tpu.nn.input_type import InputType
+        from deeplearning4j_tpu.nn.layers import Dense, OutputLayer, PReLU
+        from deeplearning4j_tpu.nn.model import (
+            MultiLayerConfiguration, MultiLayerNetwork)
+        from deeplearning4j_tpu.utils.gradientcheck import check_gradients
+        conf = MultiLayerConfiguration(
+            layers=(Dense(n_out=6, activation="identity"), PReLU(),
+                    OutputLayer(n_out=3, activation="softmax")),
+            input_type=InputType.feed_forward(4))
+        m = MultiLayerNetwork(conf).init()
+        # nonzero alphas so the negative branch has gradient signal
+        import jax.numpy as jnp
+        p1 = dict(m.params[1])
+        p1["alpha"] = jnp.asarray(np.random.RandomState(0).rand(6).astype(np.float32))
+        m.params = (m.params[0], p1) + tuple(m.params[2:])
+        rs = np.random.RandomState(1)
+        x = rs.randn(5, 4)
+        y = np.eye(3)[rs.randint(0, 3, 5)]
+        assert check_gradients(m, x, y, subset=8)
+
+
+class TestBidirectionalVector:
+    def test_return_sequences_false_golden(self):
+        """Keras Bidirectional(LSTM) classifier head: fwd last step ++ bwd
+        final state — golden equivalence proves the half-selection is right."""
+        _golden("keras_bilstm_vec")
+
+    def test_unsupported_merge_mode_with_vector_output(self):
+        from deeplearning4j_tpu.modelimport.keras import (
+            UnsupportedKerasConfigurationError, _convert_layer)
+        with pytest.raises(UnsupportedKerasConfigurationError, match="merge_mode"):
+            _convert_layer("Bidirectional", {
+                "merge_mode": "sum",
+                "layer": {"class_name": "LSTM",
+                          "config": {"units": 4, "return_sequences": False}}})

@@ -270,3 +270,57 @@ class TestGraftEntry:
         import __graft_entry__ as g
 
         g.dryrun_multichip(8)
+
+
+class TestTpValidation:
+    """Round-3 TP robustness: invalid shardings fail with NAMED errors, and
+    nested param subtrees resolve owners via nested_param_layers."""
+
+    def test_moe_expert_divisibility_error(self):
+        import pytest as _pytest
+        from deeplearning4j_tpu.models import TransformerLM
+        from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel import MeshSpec, make_mesh
+        from deeplearning4j_tpu.parallel.tp import tp_param_shardings
+
+        mesh = make_mesh(MeshSpec(data=4, model=2))
+        conf = TransformerLM(vocab_size=32, max_len=8, d_model=16, n_heads=2,
+                             n_blocks=2, moe_experts=3, dtype="float32")
+        model = MultiLayerNetwork(conf).init()
+        with _pytest.raises(ValueError, match="n_experts a multiple"):
+            tp_param_shardings(model, mesh)
+
+    def test_attn_subtree_sharded_via_nested_owner(self):
+        from jax.sharding import PartitionSpec as P
+        from deeplearning4j_tpu.models import TransformerLM
+        from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel import MeshSpec, make_mesh
+        from deeplearning4j_tpu.parallel.tp import tp_param_shardings
+
+        mesh = make_mesh(MeshSpec(data=4, model=2))
+        conf = TransformerLM(vocab_size=32, max_len=8, d_model=16, n_heads=2,
+                             n_blocks=1, dtype="float32")
+        model = MultiLayerNetwork(conf).init()
+        shardings = tp_param_shardings(model, mesh)
+        block = next(s for s in shardings if isinstance(s, dict) and "attn" in s)
+        assert block["attn"]["Wqkv"].spec == P(None, "model")
+        assert block["attn"]["Wo"].spec == P("model", None)
+
+    def test_dense_threshold_overridable(self):
+        from jax.sharding import PartitionSpec as P
+        from deeplearning4j_tpu.nn.input_type import InputType
+        from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+        from deeplearning4j_tpu.nn.model import (
+            MultiLayerConfiguration, MultiLayerNetwork)
+        from deeplearning4j_tpu.parallel import MeshSpec, make_mesh
+        from deeplearning4j_tpu.parallel.tp import tp_param_shardings
+
+        mesh = make_mesh(MeshSpec(data=4, model=2))
+        conf = MultiLayerConfiguration(
+            layers=(Dense(n_out=16), OutputLayer(n_out=4, activation="softmax")),
+            input_type=InputType.feed_forward(8))
+        model = MultiLayerNetwork(conf).init()
+        default = tp_param_shardings(model, mesh)
+        assert default[0]["W"].spec == P()          # 8x16 < threshold
+        forced = tp_param_shardings(model, mesh, dense_shard_min_elems=1)
+        assert forced[0]["W"].spec == P(None, "model")

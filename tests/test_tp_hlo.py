@@ -1,0 +1,70 @@
+"""HLO-inspection guard for tensor parallelism (round-3, VERDICT weak #9):
+the compiled TP transformer train step must not all-gather full weight
+matrices. Megatron-style sharding keeps every weight shard resident; the
+only all-gathers XLA may insert are activation-sized (plus the loss/grad
+all-reduces). A broken sharding rule typically shows up as XLA 'resharding'
+a weight — an all-gather whose result is a FULL [d_model, 3*d_model]-class
+matrix — which this test catches on the 8-device CPU mesh without TPU
+hardware."""
+
+import re
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import TransformerLM
+from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import MeshSpec, ShardedTrainer, make_mesh
+
+D_MODEL = 64
+
+
+@pytest.fixture(scope="module")
+def hlo_text():
+    import jax
+    import jax.numpy as jnp
+
+    T, vocab = 16, 37
+    mesh = make_mesh(MeshSpec(data=2, model=2, seq=2))
+    conf = TransformerLM(vocab_size=vocab, max_len=T, d_model=D_MODEL,
+                         n_heads=2, n_blocks=2, dtype="float32")
+    model = MultiLayerNetwork(conf).init()
+    trainer = ShardedTrainer(model, mesh, shard_time=False)
+
+    rs = np.random.RandomState(0)
+    x = trainer._shard_batch(rs.randint(0, vocab, (4, T)), True)
+    y = trainer._shard_batch(
+        np.eye(vocab, dtype=np.float32)[rs.randint(0, vocab, (4, T))], True)
+    step = model._get_step_fn(False)
+    lowered = step.lower(model.params, model.opt_state, model.state,
+                         jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
+                         x, y, None, None, ())
+    return lowered.compile().as_text()
+
+
+def _all_gather_result_elems(hlo_text):
+    """Element counts of all-gather results in compiled HLO text."""
+    for m in re.finditer(r"=\s*\w[\w\d]*\[([\d,]*)\][^\n=]*all-gather", hlo_text):
+        dims = m.group(1)
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        yield n
+
+
+def test_no_full_weight_allgather(hlo_text):
+    # full fused-QKV weights are d_model x 3*d_model; a gather at or above
+    # half that size means a weight got resharded instead of staying resident
+    weight_elems = D_MODEL * 3 * D_MODEL
+    offenders = [n for n in _all_gather_result_elems(hlo_text)
+                 if n >= weight_elems]
+    assert not offenders, (
+        f"TP step all-gathers tensors of sizes {offenders} "
+        f"(>= full weight {weight_elems} elements) — a sharding rule is "
+        "resharding weights instead of keeping them resident")
+
+
+def test_step_is_really_spmd(hlo_text):
+    """Sanity: collectives exist at all (dp gradient reduction)."""
+    assert "all-reduce" in hlo_text or "reduce-scatter" in hlo_text
