@@ -39,6 +39,7 @@ from deeplearning4j_tpu.datasets.normalizers import (
     NormalizerMinMaxScaler,
     NormalizerStandardize,
 )
+from deeplearning4j_tpu.datasets.transform import Schema, TransformProcess
 from deeplearning4j_tpu.datasets.records import (
     CSVRecordReader,
     CSVSequenceRecordReader,
@@ -60,6 +61,7 @@ __all__ = [
     "UciSequenceDataSetIterator", "uci_synthetic_control", "cache_dir",
     "Normalizer", "NormalizerStandardize", "NormalizerMinMaxScaler",
     "ImagePreProcessingScaler",
+    "Schema", "TransformProcess",
     "CSVRecordReader", "CSVSequenceRecordReader", "ImageRecordReader",
     "RecordReaderDataSetIterator", "SequenceRecordReaderDataSetIterator",
 ]

@@ -34,9 +34,19 @@ def cache_dir() -> str:
 def _read_idx_images(path: str) -> np.ndarray:
     op = gzip.open if path.endswith(".gz") else open
     with op(path, "rb") as f:
-        magic, n, h, w = struct.unpack(">IIII", f.read(16))
-        assert magic == 2051, f"bad idx image magic {magic}"
-        return np.frombuffer(f.read(), np.uint8).reshape(n, h, w)
+        data = f.read()
+    from deeplearning4j_tpu import native
+
+    if native.available():
+        try:
+            imgs = native.parse_idx_images(data)
+            if imgs is not None:
+                return imgs
+        except ValueError:
+            pass  # fall through to the Python path's clearer assert
+    magic, n, h, w = struct.unpack(">IIII", data[:16])
+    assert magic == 2051, f"bad idx image magic {magic}"
+    return np.frombuffer(data[16:], np.uint8).reshape(n, h, w)
 
 
 def _read_idx_labels(path: str) -> np.ndarray:

@@ -20,13 +20,29 @@ from deeplearning4j_tpu.datasets.iterator import DataSetIterator
 
 
 class CSVRecordReader:
-    """One row = one record of floats (DataVec CSVRecordReader)."""
+    """One row = one record of floats (DataVec CSVRecordReader).
+
+    Plain numeric CSVs parse through the native single-pass C++ loader
+    (deeplearning4j_tpu/native) when a toolchain is available; quoted or
+    otherwise non-trivial files fall back to the Python csv module."""
 
     def __init__(self, skip_lines: int = 0, delimiter: str = ","):
         self.skip_lines = skip_lines
         self.delimiter = delimiter
 
     def read(self, path: str) -> np.ndarray:
+        from deeplearning4j_tpu import native
+
+        if native.available():
+            with open(path, "rb") as f:
+                data = f.read()
+            try:
+                m = native.parse_csv(data, skip_lines=self.skip_lines,
+                                     delimiter=self.delimiter)
+                if m is not None:
+                    return m.astype(np.float32)
+            except ValueError:
+                pass  # quotes/exotic formatting: python csv handles it
         with open(path, newline="") as f:
             rows = list(csv.reader(f, delimiter=self.delimiter))[self.skip_lines:]
         return np.asarray([[float(v) for v in r] for r in rows if r], np.float32)

@@ -1,0 +1,132 @@
+"""Native host-side data-loading kernels (C++ via ctypes).
+
+The compute path is XLA; this package covers the RUNTIME side the reference
+also keeps native (DataVec's javacpp readers): single-pass CSV and IDX
+parsers compiled from ``fastload.cpp`` with the system g++ on first use and
+cached next to the source. Everything degrades gracefully: if no compiler
+is available the callers fall back to the pure-Python paths, so the
+framework never hard-requires a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "fastload.cpp")
+_LIB = os.path.join(_HERE, "libfastload.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> Optional[str]:
+    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+        return _LIB
+    # compile to a process-unique temp path and os.replace (atomic) so
+    # concurrent builders (e.g. jax.distributed workers) never load a
+    # half-written .so
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
+        return _LIB
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The compiled library, compiled on first call; None when no toolchain
+    is available or the cached .so fails to load (callers must fall back)."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        path = _build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        lib.parse_csv_f64.restype = ctypes.c_int
+        lib.parse_csv_f64.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_char,
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+        lib.parse_idx_images.restype = ctypes.c_int
+        lib.parse_idx_images.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64)]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def parse_csv(data: bytes, *, skip_lines: int = 0,
+              delimiter: str = ",") -> Optional[np.ndarray]:
+    """Numeric CSV bytes -> [rows, cols] float64, or None if the native lib
+    is unavailable. Raises ValueError on malformed input (ragged rows,
+    non-numeric fields) — same contract as the Python path."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    # capacity: every field needs >= 2 bytes ("x,"), so len/2+1 bounds it
+    max_vals = len(data) // 2 + 2
+    out = np.empty(max_vals, np.float64)
+    n_rows = ctypes.c_int64(0)
+    n_cols = ctypes.c_int64(0)
+    rc = lib.parse_csv_f64(
+        data, len(data), skip_lines, delimiter.encode()[0:1],
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), max_vals,
+        ctypes.byref(n_rows), ctypes.byref(n_cols))
+    if rc == 2:
+        raise ValueError("native CSV parse: ragged rows")
+    if rc == 3:
+        raise ValueError("native CSV parse: non-numeric or empty field")
+    if rc == 4:
+        raise ValueError("native CSV parse: field too long")
+    if rc != 0:
+        raise ValueError(f"native CSV parse failed (code {rc})")
+    r, c = n_rows.value, n_cols.value
+    return out[:r * c].reshape(r, c).copy()
+
+
+def parse_idx_images(data: bytes) -> Optional[np.ndarray]:
+    """IDX image archive bytes -> [n, h, w] uint8, or None if unavailable.
+    Raises ValueError on a bad magic/truncated payload."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    buf = np.frombuffer(data, np.uint8)
+    out = np.empty(max(len(data) - 16, 1), np.uint8)
+    cnt = ctypes.c_int64(0)
+    h = ctypes.c_int64(0)
+    w = ctypes.c_int64(0)
+    rc = lib.parse_idx_images(
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(data),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), out.size,
+        ctypes.byref(cnt), ctypes.byref(h), ctypes.byref(w))
+    if rc != 0:
+        raise ValueError(f"native IDX parse failed (code {rc})")
+    n, hh, ww = cnt.value, h.value, w.value
+    return out[:n * hh * ww].reshape(n, hh, ww).copy()

@@ -1,0 +1,128 @@
+"""Distributed Word2Vec over jax.distributed processes.
+
+Capability parity with the reference's Spark NLP scaleout
+(deeplearning4j-scaleout/spark/dl4j-spark-nlp: SparkWord2Vec — distributed
+vocabulary construction at the driver + parameter-averaged training rounds).
+TPU-native redesign: there is no driver. Every process holds a corpus shard;
+
+1. **Distributed vocab build**: local token counts are serialized to bytes
+   and exchanged with ``jax.experimental.multihost_utils.process_allgather``
+   (two phases: lengths, then padded payloads), merged identically on every
+   process — all hosts end with the SAME vocab (word order included).
+2. **Parameter-averaged rounds**: each round runs local epochs with the
+   fused negative-sampling steps (nlp/embeddings.py), then syn0/syn1 are
+   averaged across processes (the Spark master's averaging step, exact).
+
+Single-process mode degrades to plain Word2Vec.fit (the averaging is a
+no-op), so the same code serves both paths.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.embeddings import Word2Vec
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord, build_huffman
+
+
+def _allgather_objects(obj) -> List[dict]:
+    """Exchange one JSON-serializable object per process; returns every
+    process's object (same order everywhere). Single-process: [obj]."""
+    import jax
+
+    if jax.process_count() == 1:
+        return [obj]
+    from jax.experimental import multihost_utils as mhu
+
+    payload = np.frombuffer(json.dumps(obj).encode("utf-8"), np.uint8)
+    lengths = np.asarray(mhu.process_allgather(
+        np.asarray([payload.size], np.int32)))
+    max_len = int(lengths.max())
+    padded = np.zeros(max_len, np.uint8)
+    padded[:payload.size] = payload
+    gathered = np.asarray(mhu.process_allgather(padded))
+    out = []
+    for row, n in zip(gathered.reshape(-1, max_len), lengths.ravel()):
+        out.append(json.loads(bytes(row[:int(n)]).decode("utf-8")))
+    return out
+
+
+class DistributedWord2Vec(Word2Vec):
+    """Word2Vec whose fit() spans every jax.distributed process.
+
+    ``rounds``: parameter-averaging rounds; each runs ``epochs_per_round``
+    local epochs (total work ≈ rounds * epochs_per_round per shard).
+    """
+
+    def __init__(self, rounds: int = 1, epochs_per_round: int = 1, **kw):
+        if "epochs" in kw:
+            raise ValueError(
+                "DistributedWord2Vec: pass rounds=/epochs_per_round= instead "
+                "of epochs= (total epochs = rounds * epochs_per_round)")
+        kw["epochs"] = rounds * epochs_per_round
+        super().__init__(**kw)
+        self.rounds = rounds
+        self.epochs_per_round = epochs_per_round
+
+    # -- distributed vocab -------------------------------------------------
+    def build_vocab_distributed(self, local_token_seqs: Sequence[Sequence[str]]):
+        from collections import Counter
+
+        counts: Counter = Counter()
+        total = 0
+        for toks in local_token_seqs:
+            counts.update(toks)
+            total += len(toks)
+        merged: Counter = Counter()
+        g_total = 0
+        for remote in _allgather_objects(
+                {"counts": dict(counts), "total": total}):
+            merged.update(remote["counts"])
+            g_total += remote["total"]
+        cache = VocabCache()
+        for w, c in sorted(merged.items(), key=lambda kv: (-kv[1], kv[0])):
+            if c >= self.min_word_frequency:
+                cache.add(VocabWord(w, c))
+        cache.total_word_count = g_total
+        self.vocab = cache
+        if self.use_hs:
+            build_huffman(self.vocab)
+        return self
+
+    # -- parameter averaging ----------------------------------------------
+    def _average_params(self):
+        import jax
+
+        if jax.process_count() == 1:
+            return
+        from jax.experimental import multihost_utils as mhu
+
+        new = {}
+        for k, v in self.params.items():
+            gathered = np.asarray(mhu.process_allgather(np.asarray(v)))
+            new[k] = np.mean(gathered, axis=0).astype(np.float32)
+        import jax.numpy as jnp
+
+        self.params = {k: jnp.asarray(v) for k, v in new.items()}
+
+    # -- training ----------------------------------------------------------
+    def fit(self, local_sentences) -> "DistributedWord2Vec":
+        """``local_sentences``: THIS process's shard of the corpus."""
+        seqs = local_sentences() if callable(local_sentences) else local_sentences
+        token_seqs = self._tokenize_all(seqs)
+        if self.vocab is None:
+            self.build_vocab_distributed(token_seqs)
+        if self.params is None:
+            self._init_params()   # same seed everywhere -> identical init
+        idx_seqs = self._index_sequences(token_seqs)
+        span = self.rounds * self.epochs_per_round
+        for r in range(self.rounds):
+            # the lr anneals ONCE across all rounds (not per round)
+            self._run_epochs(idx_seqs, self.epochs_per_round,
+                             schedule_span=span,
+                             schedule_offset=r * self.epochs_per_round)
+            self._average_params()
+        return self

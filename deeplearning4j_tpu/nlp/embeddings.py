@@ -341,7 +341,18 @@ class SequenceVectors:
             self.build_vocab(seqs)
         if self.params is None:
             self._init_params()
-        idx_seqs = self._index_sequences(seqs)
+        self._run_epochs(self._index_sequences(seqs), self.epochs)
+        return self
+
+    def _run_epochs(self, idx_seqs, epochs: int, *, schedule_span: Optional[int] = None,
+                    schedule_offset: int = 0) -> None:
+        """Train ``epochs`` passes over already-indexed sequences against the
+        EXISTING vocab/params (the distributed trainer calls this one round
+        at a time between parameter-averaging steps).
+
+        ``schedule_span``/``schedule_offset``: total epochs the linear lr
+        decay spans and how many are already complete — lets a multi-round
+        caller anneal ONCE across all rounds instead of saw-toothing."""
         keep = subsample_probs(self.vocab, self.sample)
         table = unigram_table(self.vocab)
         if self.use_hs:
@@ -349,11 +360,11 @@ class SequenceVectors:
             codes_j, points_j = jnp.asarray(codes), jnp.asarray(points)
             hmask_j = jnp.asarray(hmask)
 
-        total_pairs_est = max(
-            sum(len(s) for s in idx_seqs) * self.window * self.epochs, 1
-        )
-        seen = 0
-        for _ in range(self.epochs):
+        span = schedule_span if schedule_span is not None else epochs
+        pairs_per_epoch = sum(len(s) for s in idx_seqs) * self.window
+        total_pairs_est = max(pairs_per_epoch * span, 1)
+        seen = pairs_per_epoch * schedule_offset
+        for _ in range(epochs):
             pg = _PairGenerator(self.window, keep, self._rs)
             if self.elements_learning == "cbow" and not self.use_hs:
                 # true CBOW (CBOW.java): the window AVERAGE predicts the
@@ -390,7 +401,6 @@ class SequenceVectors:
                         self.params, jnp.asarray(centers), jnp.asarray(contexts),
                         jnp.asarray(negs), jnp.asarray(lr, jnp.float32),
                     )
-        return self
 
     def _draw_negatives(self, table: np.ndarray, shape) -> np.ndarray:
         return self._rs.choice(len(table), size=shape, p=table).astype(np.int32)

@@ -20,6 +20,7 @@ from deeplearning4j_tpu.train.listeners import (
     CollectScoresListener,
     ComposedListener,
     PerformanceListener,
+    ProfilerListener,
     ScoreIterationListener,
     TimeIterationListener,
     TrainingListener,
@@ -49,6 +50,7 @@ __all__ = [
     "schedule_value",
     "TrainingListener",
     "BaseTrainingListener",
+    "ProfilerListener",
     "ScoreIterationListener",
     "PerformanceListener",
     "CollectScoresListener",

@@ -90,6 +90,46 @@ class PerformanceListener(TrainingListener):
             self._samples = 0
 
 
+class ProfilerListener(TrainingListener):
+    """Capture a jax-profiler (xprof/perfetto) trace for a window of
+    training iterations — §5.1 tracing parity; the reference's equivalent is
+    the SystemInfo/benchmark tooling, here it is the real XLA profiler.
+
+    Writes a TensorBoard-loadable trace directory::
+
+        model.set_listeners(ProfilerListener("/tmp/trace", start=10, stop=20))
+    """
+
+    def __init__(self, log_dir: str, start: int = 10, stop: int = 20):
+        if stop <= start:
+            raise ValueError("stop must be > start")
+        self.log_dir = str(log_dir)
+        self.start = start
+        self.stop = stop
+        self._active = False
+        self.captured = False
+
+    def iteration_done(self, model, iteration, score, batch_size=0):
+        import jax
+
+        if not self._active and not self.captured and iteration >= self.start:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+        elif self._active and iteration >= self.stop:
+            jax.profiler.stop_trace()
+            self._active = False
+            self.captured = True
+
+    def on_epoch_end(self, model, epoch):
+        # never leak an open trace past training
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+            self.captured = True
+
+
 class CollectScoresListener(TrainingListener):
     """Accumulate (iteration, score) pairs
     (CollectScoresIterationListener.java)."""

@@ -1,0 +1,50 @@
+"""Subprocess worker for tests/test_distributed_w2v.py: one of two processes
+training DistributedWord2Vec on its corpus shard."""
+
+import json
+import os
+import sys
+
+
+def main():
+    idx = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+    outdir = sys.argv[4]
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from __graft_entry__ import _provision_cpu_mesh
+
+    _provision_cpu_mesh(1)
+    from deeplearning4j_tpu.parallel.distributed import init_distributed
+
+    init_distributed(f"127.0.0.1:{port}", num_processes=nproc, process_id=idx)
+
+    import numpy as np
+    from deeplearning4j_tpu.nlp.distributed import DistributedWord2Vec
+
+    # shard 0 only ever sees cats, shard 1 only dogs — merged vocab must
+    # contain BOTH on BOTH processes
+    cats = ["cat kitten purr feline meow whiskers"] * 30
+    dogs = ["dog puppy bark canine woof fetch"] * 30
+    local = cats if idx == 0 else dogs
+
+    w2v = DistributedWord2Vec(rounds=3, epochs_per_round=1, layer_size=12,
+                              min_word_frequency=1, negative=4, seed=9,
+                              learning_rate=0.05)
+    w2v.fit(local)
+
+    out = {
+        "process": idx,
+        "vocab": [w.word for w in w2v.vocab.words],
+        "syn0_digest": float(np.sum(np.abs(w2v.syn0))),
+        "has_cat": w2v.has_word("cat"),
+        "has_dog": w2v.has_word("dog"),
+    }
+    np.savez(os.path.join(outdir, f"w2v_{idx}.npz"), syn0=w2v.syn0)
+    with open(os.path.join(outdir, f"w2v_{idx}.json"), "w") as f:
+        json.dump(out, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""Distributed Word2Vec (nlp/distributed.py): 2 real processes, disjoint
+corpus shards — the Spark dl4j-spark-nlp replacement (distributed vocab
+build + parameter-averaged rounds)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "_w2v_worker.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_two_process_vocab_merge_and_parameter_averaging(tmp_path):
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, str(i), "2", str(port), str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out.decode("utf-8", "replace"))
+    for i, p in enumerate(procs):
+        assert p.returncode == 0, f"worker {i} failed:\n{outs[i][-3000:]}"
+
+    metas = []
+    for i in range(2):
+        with open(tmp_path / f"w2v_{i}.json") as f:
+            metas.append(json.load(f))
+    # merged vocab: both shards' words on both processes, identical order
+    assert metas[0]["vocab"] == metas[1]["vocab"]
+    for m in metas:
+        assert m["has_cat"] and m["has_dog"], m
+
+    # parameter averaging: final embeddings identical across processes
+    s0 = np.load(tmp_path / "w2v_0.npz")["syn0"]
+    s1 = np.load(tmp_path / "w2v_1.npz")["syn0"]
+    np.testing.assert_allclose(s0, s1, rtol=1e-6, atol=1e-7)
+    # and training actually moved the table from its (tiny) init
+    assert float(np.abs(s0).sum()) > 1.0
+
+
+def test_single_process_degrades_to_plain_fit():
+    from deeplearning4j_tpu.nlp.distributed import DistributedWord2Vec
+
+    w2v = DistributedWord2Vec(rounds=2, epochs_per_round=1, layer_size=8,
+                              min_word_frequency=1, negative=3, seed=4)
+    w2v.fit(["the quick brown fox jumps over the lazy dog"] * 20)
+    assert w2v.has_word("fox")
+    v = w2v.get_word_vector("fox")
+    assert v is not None and np.isfinite(v).all()
+
+
+def test_epochs_kwarg_rejected():
+    from deeplearning4j_tpu.nlp.distributed import DistributedWord2Vec
+    import pytest
+    with pytest.raises(ValueError, match="rounds"):
+        DistributedWord2Vec(epochs=5)

@@ -1,0 +1,123 @@
+"""Native C++ data-loading kernels (deeplearning4j_tpu/native): built with
+the system g++ on first use, ctypes ABI, graceful fallback without a
+toolchain."""
+
+import csv
+import io
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import native
+
+HAVE = native.available()
+needs_native = pytest.mark.skipif(not HAVE, reason="no C++ toolchain")
+
+
+@needs_native
+class TestCsvNative:
+    def test_matches_python_csv(self, tmp_path):
+        rs = np.random.RandomState(0)
+        m = rs.randn(200, 7)
+        p = tmp_path / "data.csv"
+        with open(p, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow([f"c{i}" for i in range(7)])  # header
+            w.writerows(m.tolist())
+        got = native.parse_csv(open(p, "rb").read(), skip_lines=1)
+        np.testing.assert_allclose(got, m, rtol=1e-12)
+
+    def test_alt_delimiter_and_blank_lines(self):
+        data = b"1.5;2.5\n\n3.0;-4.0\n"
+        got = native.parse_csv(data, delimiter=";")
+        np.testing.assert_allclose(got, [[1.5, 2.5], [3.0, -4.0]])
+
+    def test_ragged_rejected(self):
+        with pytest.raises(ValueError, match="ragged"):
+            native.parse_csv(b"1,2\n3\n")
+
+    def test_non_numeric_rejected(self):
+        with pytest.raises(ValueError, match="non-numeric"):
+            native.parse_csv(b"1,2\n3,frog\n")
+
+    def test_record_reader_uses_native_and_matches_python(self, tmp_path):
+        from deeplearning4j_tpu.datasets.records import CSVRecordReader
+
+        rs = np.random.RandomState(1)
+        m = rs.rand(50, 4)
+        p = tmp_path / "r.csv"
+        np.savetxt(p, m, delimiter=",")
+        got = CSVRecordReader().read(str(p))
+        np.testing.assert_allclose(got, m.astype(np.float32), rtol=1e-6)
+
+    def test_quoted_csv_falls_back(self, tmp_path):
+        p = tmp_path / "q.csv"
+        with open(p, "w") as f:
+            f.write('"1.0","2.0"\n"3.0","4.0"\n')
+        from deeplearning4j_tpu.datasets.records import CSVRecordReader
+
+        got = CSVRecordReader().read(str(p))
+        np.testing.assert_allclose(got, [[1.0, 2.0], [3.0, 4.0]])
+
+
+@needs_native
+class TestIdxNative:
+    def _idx_bytes(self, imgs: np.ndarray) -> bytes:
+        n, h, w = imgs.shape
+        return struct.pack(">IIII", 0x00000803, n, h, w) + imgs.tobytes()
+
+    def test_roundtrip(self):
+        rs = np.random.RandomState(2)
+        imgs = rs.randint(0, 256, (5, 4, 3), dtype=np.uint8)
+        got = native.parse_idx_images(self._idx_bytes(imgs))
+        np.testing.assert_array_equal(got, imgs)
+
+    def test_bad_magic_rejected(self):
+        with pytest.raises(ValueError):
+            native.parse_idx_images(b"\x00\x00\x08\x01" + b"\x00" * 20)
+
+
+class TestFallback:
+    def test_reader_works_without_native(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(native, "available", lambda: False)
+        from deeplearning4j_tpu.datasets.records import CSVRecordReader
+
+        p = tmp_path / "f.csv"
+        np.savetxt(p, np.asarray([[1.0, 2.0]]), delimiter=",")
+        got = CSVRecordReader().read(str(p))
+        np.testing.assert_allclose(got, [[1.0, 2.0]])
+
+    def test_parse_csv_none_without_lib(self, monkeypatch):
+        monkeypatch.setattr(native, "get_lib", lambda: None)
+        assert native.parse_csv(b"1,2\n") is None
+
+
+@needs_native
+class TestReviewRegressions:
+    def test_long_field_rejected_not_truncated(self):
+        long_field = "1." + "0" * 80
+        with pytest.raises(ValueError, match="too long"):
+            native.parse_csv(f"{long_field},2\n".encode())
+
+    def test_trailing_delimiter_rejected_like_python(self):
+        with pytest.raises(ValueError, match="empty"):
+            native.parse_csv(b"1,2,\n3,4,\n")
+
+    def test_empty_interior_field_rejected(self):
+        with pytest.raises(ValueError, match="empty"):
+            native.parse_csv(b"1,,2\n")
+
+    def test_idx_overflow_header_rejected(self):
+        hdr = struct.pack(">IIII", 0x00000803, 2**31, 2**31, 2)
+        with pytest.raises(ValueError):
+            native.parse_idx_images(hdr + b"\x00" * 64)
+
+    def test_fetchers_use_native_idx(self, tmp_path):
+        rs = np.random.RandomState(5)
+        imgs = rs.randint(0, 256, (6, 28, 28), dtype=np.uint8)
+        p = tmp_path / "train-images-idx3-ubyte"
+        with open(p, "wb") as f:
+            f.write(struct.pack(">IIII", 2051, 6, 28, 28) + imgs.tobytes())
+        from deeplearning4j_tpu.datasets.fetchers import _read_idx_images
+        np.testing.assert_array_equal(_read_idx_images(str(p)), imgs)

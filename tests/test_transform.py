@@ -1,0 +1,128 @@
+"""DataVec-style Schema/TransformProcess (datasets/transform.py)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.transform import Schema, TransformProcess
+
+
+def _schema():
+    return (Schema.builder()
+            .add_double("sepal_len", "sepal_wid")
+            .add_integer("count")
+            .add_categorical("species", ["setosa", "versicolor", "virginica"])
+            .add_string("note")
+            .build())
+
+
+def _records():
+    return [
+        [5.1, 3.5, 2, "setosa", "ok"],
+        [6.2, 2.9, 0, "virginica", "ok"],
+        [4.8, 3.0, 5, "versicolor", "meh"],
+        [7.0, 3.2, 1, "setosa", "bad"],
+    ]
+
+
+class TestSchema:
+    def test_builder_and_queries(self):
+        s = _schema()
+        assert s.names() == ["sepal_len", "sepal_wid", "count", "species", "note"]
+        assert s.column("species").categories == ("setosa", "versicolor", "virginica")
+        with pytest.raises(KeyError):
+            s.index_of("nope")
+
+    def test_duplicate_names_rejected(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            Schema.builder().add_double("a", "a").build()
+
+    def test_serde(self):
+        s = _schema()
+        assert Schema.from_dict(s.to_dict()) == s
+
+
+class TestTransformProcess:
+    def test_schema_derivation_without_data(self):
+        tp = (TransformProcess.builder(_schema())
+              .remove_columns("note")
+              .categorical_to_one_hot("species")
+              .normalize_min_max("sepal_len", 4.0, 8.0)
+              .build())
+        out = tp.final_schema().names()
+        assert out == ["sepal_len", "sepal_wid", "count",
+                       "species[setosa]", "species[versicolor]",
+                       "species[virginica]"]
+
+    def test_execute_pipeline(self):
+        tp = (TransformProcess.builder(_schema())
+              .remove_columns("note")
+              .categorical_to_integer("species")
+              .double_math_op("sepal_wid", "multiply", 2.0)
+              .normalize_min_max("sepal_len", 4.0, 8.0)
+              .build())
+        cols = tp.execute(_records())
+        np.testing.assert_allclose(cols["sepal_len"],
+                                   [(5.1 - 4) / 4, (6.2 - 4) / 4,
+                                    (4.8 - 4) / 4, (7.0 - 4) / 4])
+        np.testing.assert_allclose(cols["sepal_wid"], [7.0, 5.8, 6.0, 6.4])
+        np.testing.assert_array_equal(cols["species"], [0, 2, 1, 0])
+
+    def test_row_filter(self):
+        tp = (TransformProcess.builder(_schema())
+              .filter_numeric("count", ">=", 2)    # DROP rows with count >= 2
+              .build())
+        cols = tp.execute(_records())
+        assert len(cols["sepal_len"]) == 2
+        np.testing.assert_array_equal(cols["count"], [0, 1])
+
+    def test_replace_invalid(self):
+        s = Schema.builder().add_double("x").build()
+        tp = TransformProcess.builder(s).replace_invalid("x", -1.0).build()
+        cols = tp.execute([[1.0], [float("nan")], [float("inf")]])
+        np.testing.assert_allclose(cols["x"], [1.0, -1.0, -1.0])
+
+    def test_to_matrix_and_reject_nonnumeric(self):
+        tp = (TransformProcess.builder(_schema())
+              .remove_columns("note")
+              .categorical_to_one_hot("species")
+              .build())
+        m = tp.execute_to_matrix(_records())
+        assert m.shape == (4, 6)
+        tp2 = TransformProcess.builder(_schema()).build()
+        with pytest.raises(ValueError, match="convert it"):
+            tp2.execute_to_matrix(_records())
+
+    def test_invalid_chain_fails_at_build(self):
+        with pytest.raises(ValueError, match="not categorical"):
+            (TransformProcess.builder(_schema())
+             .categorical_to_integer("sepal_len").build())
+        with pytest.raises(KeyError):
+            (TransformProcess.builder(_schema())
+             .remove_columns("ghost").build())
+
+    def test_unknown_category_value_raises(self):
+        tp = (TransformProcess.builder(_schema())
+              .categorical_to_integer("species").build())
+        bad = _records()
+        bad[0][3] = "tulip"
+        with pytest.raises(ValueError, match="tulip"):
+            tp.execute(bad)
+
+    def test_serde_roundtrip_executes_identically(self):
+        tp = (TransformProcess.builder(_schema())
+              .remove_columns("note")
+              .rename_column("count", "n")
+              .categorical_to_one_hot("species")
+              .filter_numeric("n", ">", 3)
+              .build())
+        back = TransformProcess.from_dict(tp.to_dict())
+        a = tp.execute_to_matrix(_records())
+        b = back.execute_to_matrix(_records())
+        np.testing.assert_array_equal(a, b)
+        assert back.final_schema() == tp.final_schema()
+
+    def test_columnar_input(self):
+        s = Schema.builder().add_double("a", "b").build()
+        tp = TransformProcess.builder(s).double_math_op("a", "add", 1).build()
+        cols = tp.execute({"a": [1.0, 2.0], "b": [3.0, 4.0]})
+        np.testing.assert_allclose(cols["a"], [2.0, 3.0])
