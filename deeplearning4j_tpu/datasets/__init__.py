@@ -20,6 +20,7 @@ from deeplearning4j_tpu.datasets.iterator import (
     JointParallelDataSetIterator,
     ListDataSetIterator,
     MultipleEpochsIterator,
+    ShardedDataSetIterator,
 )
 from deeplearning4j_tpu.datasets.fetchers import (
     CifarDataSetIterator,
@@ -54,7 +55,7 @@ __all__ = [
     "AsyncMultiDataSetIterator", "EarlyTerminationDataSetIterator",
     "MultipleEpochsIterator", "DataSetIteratorSplitter",
     "BenchmarkDataSetIterator", "FileDataSetIterator",
-    "JointParallelDataSetIterator",
+    "JointParallelDataSetIterator", "ShardedDataSetIterator",
     "MnistDataSetIterator", "EmnistDataSetIterator", "IrisDataSetIterator",
     "CifarDataSetIterator", "TinyImageNetDataSetIterator",
     "SvhnDataSetIterator", "LFWDataSetIterator",

@@ -243,3 +243,55 @@ class JointParallelDataSetIterator(DataSetIterator):
                 except StopIteration:
                     pass
             actives = nxt
+
+
+class ShardedDataSetIterator(DataSetIterator):
+    """Per-process shard of a base iterator for MULTI-HOST input pipelines
+    (the dl4j-spark per-worker data plumbing, SPMD-style). Every host runs
+    the SAME global stream; batches are consumed in GROUPS of N consecutive
+    batches and process p takes the p-th member of each group — so every
+    yielded step exists on every host (no collective deadlock from unequal
+    shard counts). Groups that are incomplete (stream tail) or whose member
+    batches differ in size (a short remainder batch) are dropped on ALL
+    hosts identically, preserving ParallelWrapper's equal-local-batch
+    invariant.
+
+    ``process_index``/``process_count`` default to the live jax.distributed
+    values; pass BOTH explicitly for testing or custom topologies."""
+
+    def __init__(self, base, process_index: Optional[int] = None,
+                 process_count: Optional[int] = None):
+        super().__init__(getattr(base, "batch_size", 32))
+        if (process_index is None) != (process_count is None):
+            raise ValueError(
+                "pass both process_index and process_count, or neither")
+        self.base = base
+        self._idx = process_index
+        self._cnt = process_count
+
+    def _coords(self):
+        if self._idx is not None:
+            return self._idx, self._cnt
+        import jax
+
+        return jax.process_index(), jax.process_count()
+
+    def _produce(self):
+        p, n = self._coords()
+        if not (0 <= p < n):
+            raise ValueError(f"process_index {p} out of range for {n} processes")
+        src = self.base() if callable(self.base) else self.base
+        group: list = []
+        for ds in src:
+            group.append(ds)
+            if len(group) == n:
+                sizes = {len(b.features) if hasattr(b, "features") else len(b[0])
+                         for b in group}
+                if len(sizes) == 1:   # equal-size group: safe on every host
+                    yield group[p]
+                group = []
+        # trailing incomplete group dropped (identically on all hosts)
+
+    def reset(self):
+        if hasattr(self.base, "reset"):
+            self.base.reset()

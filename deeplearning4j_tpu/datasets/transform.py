@@ -139,7 +139,9 @@ class _Op:
         return cols
 
     def to_dict(self) -> dict:
-        d = {"op": type(self).OP}
+        # the type tag lives under "transform", NOT "op" — DoubleMathOp has
+        # an instance field named "op" that must round-trip untouched
+        d = {"transform": type(self).OP}
         d.update({k: (list(v) if isinstance(v, tuple) else v)
                   for k, v in self.__dict__.items()})
         return d
@@ -233,7 +235,12 @@ class CategoricalToOneHot(_Op):
     def apply(self, cols, schema):
         cats = list(schema.column(self.name).categories)
         lut = {c: i for i, c in enumerate(cats)}
-        idx = np.asarray([lut[str(v)] for v in cols[self.name]], np.int64)
+        try:
+            idx = np.asarray([lut[str(v)] for v in cols[self.name]], np.int64)
+        except KeyError as e:
+            raise ValueError(
+                f"column {self.name!r}: value {e} not in categories {cats}"
+            ) from None
         eye = np.eye(len(cats), dtype=np.float64)[idx]
         out = {}
         for k, v in cols.items():
@@ -487,7 +494,7 @@ class TransformProcess:
         ops = []
         for od in d["ops"]:
             od = dict(od)
-            cls = _OPS[od.pop("op")]
+            cls = _OPS[od.pop("transform")]
             kw = {k: (tuple(v) if isinstance(v, list) else v)
                   for k, v in od.items()}
             ops.append(cls(**kw))

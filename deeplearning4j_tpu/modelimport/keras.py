@@ -138,8 +138,10 @@ def _keras1_normalize(class_name: str, cfg: dict) -> dict:
         cfg.setdefault("pool_size", cfg["pool_length"])
     if "stride" in cfg and "strides" not in cfg:
         cfg.setdefault("strides", cfg["stride"])
-    if class_name == "Dropout" and "p" in cfg:
+    if class_name in ("Dropout", "GaussianDropout", "AlphaDropout") and "p" in cfg:
         cfg.setdefault("rate", cfg["p"])
+    if class_name == "GaussianNoise" and "sigma" in cfg:
+        cfg.setdefault("stddev", cfg["sigma"])
     return cfg
 
 
@@ -304,6 +306,18 @@ def _convert_layer(class_name: str, cfg: dict, *, as_output: bool = False,
         from deeplearning4j_tpu.nn.layers import RepeatVector
 
         return RepeatVector(n=int(cfg["n"]))
+    if class_name == "GaussianNoise":
+        from deeplearning4j_tpu.nn.layers import GaussianNoise
+
+        return GaussianNoise(stddev=float(cfg["stddev"]))
+    if class_name == "GaussianDropout":
+        from deeplearning4j_tpu.nn.layers import GaussianDropout
+
+        return GaussianDropout(rate=float(cfg["rate"]))
+    if class_name == "AlphaDropout":
+        from deeplearning4j_tpu.nn.layers import AlphaDropout
+
+        return AlphaDropout(dropout=float(cfg["rate"]))
     if class_name == "Bidirectional":
         from deeplearning4j_tpu.nn.layers import Bidirectional
 
@@ -504,7 +518,8 @@ def _sequential_from_config(model_config: dict) -> Tuple[MultiLayerConfiguration
     names: List[Optional[str]] = []
     _structural = ("InputLayer", "Flatten", "Dropout", "Activation",
                    "LeakyReLU", "ELU", "ThresholdedReLU", "PReLU",
-                   "Cropping2D", "Permute", "RepeatVector")
+                   "Cropping2D", "Permute", "RepeatVector",
+                   "GaussianNoise", "GaussianDropout", "AlphaDropout")
     last_idx = max(
         i for i, lc in enumerate(layers_cfg)
         if lc["class_name"] not in _structural

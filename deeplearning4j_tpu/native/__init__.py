@@ -20,7 +20,9 @@ import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "fastload.cpp")
-_LIB = os.path.join(_HERE, "libfastload.so")
+# .so.bin, NOT .so: pkgutil.walk_packages would otherwise try to import the
+# artifact as a CPython extension module (ctypes loads any filename)
+_LIB = os.path.join(_HERE, "fastload.so.bin")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -28,8 +30,15 @@ _tried = False
 
 
 def _build() -> Optional[str]:
-    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
-        return _LIB
+    if os.path.exists(_LIB):
+        try:
+            fresh = os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)
+        except OSError:
+            fresh = True  # source missing (binary-only deploy): use the cache
+        if fresh:
+            return _LIB
+    if not os.path.exists(_SRC):
+        return None
     # compile to a process-unique temp path and os.replace (atomic) so
     # concurrent builders (e.g. jax.distributed workers) never load a
     # half-written .so

@@ -8,7 +8,10 @@
 //
 // Exposed as a plain C ABI consumed via ctypes (no pybind11 in this image).
 //
-// Build: g++ -O3 -shared -fPIC -std=c++17 fastload.cpp -o libfastload.so
+// Built automatically by native/__init__.py:
+//   g++ -O3 -shared -fPIC -std=c++17 fastload.cpp -o fastload.so.bin
+// (the .so.bin suffix keeps pkgutil from importing the artifact as a
+// CPython extension module)
 
 #include <cstdint>
 #include <cstdlib>

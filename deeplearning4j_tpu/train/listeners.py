@@ -120,8 +120,10 @@ class ProfilerListener(TrainingListener):
             self._active = False
             self.captured = True
 
-    def on_epoch_end(self, model, epoch):
-        # never leak an open trace past training
+    def close(self):
+        """Stop an in-flight trace (call when training ends inside the
+        window). Epoch boundaries deliberately do NOT stop the trace — a
+        window may span epochs (1-iteration-per-epoch fits are common)."""
         if self._active:
             import jax
 

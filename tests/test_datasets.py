@@ -237,3 +237,52 @@ class TestSvhnLfw:
             assert x.shape == (12, 32, 32, 3) and y.shape == (12, 6)
         finally:
             del os.environ["DL4J_TPU_SYNTH_N"]
+
+
+class TestShardedIterator:
+    def test_disjoint_cover_across_processes(self):
+        from deeplearning4j_tpu.datasets import ListDataSetIterator, ShardedDataSetIterator
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        import numpy as np
+        ds = DataSet(np.arange(40, dtype=np.float32)[:, None],
+                     np.ones((40, 1), np.float32))
+        mk = lambda: ListDataSetIterator(ds, 4)  # 10 batches
+        shards = [list(ShardedDataSetIterator(mk(), process_index=i,
+                                              process_count=2))
+                  for i in range(2)]
+        assert len(shards[0]) == 5 and len(shards[1]) == 5
+        seen = np.concatenate([b.features.ravel()
+                               for s in shards for b in s])
+        np.testing.assert_array_equal(np.sort(seen), np.arange(40))
+
+    def test_single_process_passthrough(self):
+        import jax
+        from deeplearning4j_tpu.datasets import ListDataSetIterator, ShardedDataSetIterator
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        import numpy as np
+        ds = DataSet(np.ones((8, 2), np.float32), np.ones((8, 1), np.float32))
+        it = ShardedDataSetIterator(ListDataSetIterator(ds, 4))
+        assert len(list(it)) == 2  # jax.process_count()==1 -> every batch
+
+    def test_uneven_stream_drops_tail_group_consistently(self):
+        from deeplearning4j_tpu.datasets import ListDataSetIterator, ShardedDataSetIterator
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        import numpy as np
+        # 42 rows / batch 4 -> 10 full batches + one short batch of 2;
+        # with 3 processes: 3 complete groups (9 batches), the group
+        # containing the short batch is dropped on every process
+        ds = DataSet(np.arange(42, dtype=np.float32)[:, None],
+                     np.ones((42, 1), np.float32))
+        mk = lambda: ListDataSetIterator(ds, 4)
+        shards = [list(ShardedDataSetIterator(mk(), process_index=i,
+                                              process_count=3))
+                  for i in range(3)]
+        assert [len(s) for s in shards] == [3, 3, 3]
+        for s in shards:
+            assert all(len(b.features) == 4 for b in s)
+
+    def test_partial_override_rejected(self):
+        from deeplearning4j_tpu.datasets import ShardedDataSetIterator
+        import pytest
+        with pytest.raises(ValueError, match="both"):
+            ShardedDataSetIterator([], process_index=1)

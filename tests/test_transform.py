@@ -126,3 +126,18 @@ class TestTransformProcess:
         tp = TransformProcess.builder(s).double_math_op("a", "add", 1).build()
         cols = tp.execute({"a": [1.0, 2.0], "b": [3.0, 4.0]})
         np.testing.assert_allclose(cols["a"], [2.0, 3.0])
+
+
+class TestReviewRegressions:
+    def test_math_op_serde_roundtrip(self):
+        """Regression: the 'op' field must not collide with the type tag."""
+        s = Schema.builder().add_double("x").build()
+        tp = TransformProcess.builder(s).double_math_op("x", "add", 1.5).build()
+        back = TransformProcess.from_dict(tp.to_dict())
+        np.testing.assert_allclose(back.execute([[1.0]])["x"], [2.5])
+
+    def test_onehot_unknown_value_is_valueerror(self):
+        s = Schema.builder().add_categorical("c", ["a", "b"]).build()
+        tp = TransformProcess.builder(s).categorical_to_one_hot("c").build()
+        with pytest.raises(ValueError, match="categories"):
+            tp.execute([["z"]])
