@@ -215,6 +215,26 @@ def bench_resnet50():
     if peak:
         out["mfu"] = round(3.0 * fwd * ips / peak, 4)
         out["peak_tflops"] = peak / 1e12
+
+    # TPU-optimized stem variant (SpaceToDepth + 4x4/s1 — NOT the reference
+    # layout; reported separately, labeled)
+    if not SMOKE:
+        cg2 = ComputationGraph(
+            ResNet50(height=size, width=size, num_classes=classes,
+                     dtype=dtype, stem="space_to_depth")).init()
+
+        def run2(n):
+            loss = None
+            for _ in range(n):
+                loss = cg2.fit_batch((x, y))
+            jax.block_until_ready(loss)
+
+        dt2, steps2 = _timed(run2, warmup_steps=3, steps=20)
+        ips2 = steps2 * batch / dt2
+        fwd2 = _graph_fwd_flops_per_example(cg2)  # the variant's OWN flops
+        out["s2d_stem_variant_images_per_sec"] = round(ips2, 1)
+        if peak:
+            out["s2d_stem_variant_mfu"] = round(3.0 * fwd2 * ips2 / peak, 4)
     return out
 
 

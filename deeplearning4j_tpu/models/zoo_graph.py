@@ -27,6 +27,7 @@ from deeplearning4j_tpu.nn.layers import (
     GlobalPooling,
     LocalResponseNormalization,
     OutputLayer,
+    SpaceToDepth,
     Subsampling2D,
     Yolo2OutputLayer,
     ZeroPadding2D,
@@ -141,15 +142,29 @@ def _bottleneck(g, name: str, inp: str, filters: Tuple[int, int, int],
 
 def ResNet50(height: int = 224, width: int = 224, channels: int = 3,
              num_classes: int = 1000, updater=None, seed: int = 12345,
-             dtype: str = "float32") -> ComputationGraphConfiguration:
+             dtype: str = "float32", stem: str = "conv7") -> ComputationGraphConfiguration:
     """ResNet-50 (zoo/model/ResNet50.java): conv7 + 3/4/6/3 bottleneck stages.
-    BASELINE config #2."""
+    BASELINE config #2.
+
+    ``stem="conv7"`` is the reference-faithful 7x7/s2 stem.
+    ``stem="space_to_depth"`` is the TPU-optimized MLPerf-style variant:
+    SpaceToDepth(2) + 4x4/s1 conv — same receptive-field class and output
+    shape, but the conv's contraction dim is 4*4*(4*channels) instead of
+    7*7*channels, which fills the 128-lane MXU instead of running ~3/128
+    occupied. Same parameter COUNT class, different layout — checkpoints
+    are not interchangeable between stems."""
     g = (ComputationGraphConfiguration.builder()
          .add_inputs("in")
          .set_input_types(InputType.convolutional(height, width, channels)))
-    stem = _conv_bn(g, "stem", "in", 64, (7, 7), (2, 2))
+    if stem == "space_to_depth":
+        g.add_layer("stem_s2d", SpaceToDepth(block=2), "in")
+        stem_v = _conv_bn(g, "stem", "stem_s2d", 64, (4, 4), (1, 1))
+    elif stem == "conv7":
+        stem_v = _conv_bn(g, "stem", "in", 64, (7, 7), (2, 2))
+    else:
+        raise ValueError(f"stem must be 'conv7' or 'space_to_depth', got {stem!r}")
     g.add_layer("stem_pool", Subsampling2D(kernel=(3, 3), stride=(2, 2),
-                                           convolution_mode="same"), stem)
+                                           convolution_mode="same"), stem_v)
     x = "stem_pool"
     stages = [
         ("s2", (64, 64, 256), 3, (1, 1)),

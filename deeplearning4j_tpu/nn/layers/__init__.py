@@ -28,8 +28,10 @@ from deeplearning4j_tpu.nn.layers.convolution import (
     Conv2D,
     Cropping2D,
     Deconv2D,
+    DepthToSpace,
     DepthwiseConv2D,
     SeparableConv2D,
+    SpaceToDepth,
     Subsampling1D,
     Subsampling2D,
     Upsampling2D,

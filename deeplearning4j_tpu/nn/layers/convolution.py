@@ -474,3 +474,60 @@ class Cropping2D(LayerConfig):
         t, b, l, r = self._crops()
         h, w = x.shape[1], x.shape[2]
         return x[:, t : h - b, l : w - r, :], state
+
+
+@register_layer("space_to_depth")
+@dataclass
+class SpaceToDepth(LayerConfig):
+    """[B,H,W,C] -> [B,H/b,W/b,C*b^2] (SpaceToDepthLayer.java). On TPU this
+    is also the MLPerf-style stem trick: it turns a thin-channel stem conv
+    (C_in=3, which underfills the 128-lane MXU contraction) into a
+    b^2-richer one."""
+
+    CONSUMES_CONV = True
+
+    block: int = 2
+
+    def output_type(self, input_type: InputType) -> InputType:
+        b = int(self.block)
+        if input_type.height % b or input_type.width % b:
+            raise ValueError(
+                f"SpaceToDepth: spatial dims {input_type.height}x"
+                f"{input_type.width} not divisible by block {b}")
+        return InputType.convolutional(
+            input_type.height // b, input_type.width // b,
+            input_type.channels * b * b)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        b = int(self.block)
+        B, H, W, C = x.shape
+        y = x.reshape(B, H // b, b, W // b, b, C)
+        y = y.transpose(0, 1, 3, 2, 4, 5).reshape(B, H // b, W // b, b * b * C)
+        return y, state
+
+
+@register_layer("depth_to_space")
+@dataclass
+class DepthToSpace(LayerConfig):
+    """[B,H,W,C*b^2] -> [B,H*b,W*b,C] (the inverse; Upsampling alternative)."""
+
+    CONSUMES_CONV = True
+
+    block: int = 2
+
+    def output_type(self, input_type: InputType) -> InputType:
+        b = int(self.block)
+        if input_type.channels % (b * b):
+            raise ValueError(
+                f"DepthToSpace: channels {input_type.channels} not divisible "
+                f"by block^2 {b * b}")
+        return InputType.convolutional(
+            input_type.height * b, input_type.width * b,
+            input_type.channels // (b * b))
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        b = int(self.block)
+        B, H, W, C = x.shape
+        y = x.reshape(B, H, W, b, b, C // (b * b))
+        y = y.transpose(0, 1, 3, 2, 4, 5).reshape(B, H * b, W * b, C // (b * b))
+        return y, state

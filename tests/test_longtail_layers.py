@@ -296,3 +296,45 @@ class TestGradientChecksNewHeads:
         x = rs.randn(2, 5, 5, 2)
         y = np.eye(3)[rs.randint(0, 3, (2, 5, 5))]
         assert check_gradients(m, x, y, subset=20)
+
+
+class TestSpaceToDepth:
+    def test_shapes_and_inverse(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.layers import DepthToSpace, SpaceToDepth
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.rand(2, 4, 6, 3).astype(np.float32))
+        s2d = SpaceToDepth(block=2)
+        y, _ = s2d.apply({}, {}, x)
+        assert y.shape == (2, 2, 3, 12)
+        back, _ = DepthToSpace(block=2).apply({}, {}, y)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x))
+
+    def test_output_type_and_validation(self):
+        from deeplearning4j_tpu.nn.input_type import InputType
+        from deeplearning4j_tpu.nn.layers import SpaceToDepth
+        ot = SpaceToDepth(block=2).output_type(InputType.convolutional(8, 8, 3))
+        assert (ot.height, ot.width, ot.channels) == (4, 4, 12)
+        import pytest as _p
+        with _p.raises(ValueError, match="divisible"):
+            SpaceToDepth(block=2).output_type(InputType.convolutional(7, 8, 3))
+
+    def test_serde(self):
+        from deeplearning4j_tpu.nn.config import LayerConfig
+        from deeplearning4j_tpu.nn.layers import DepthToSpace, SpaceToDepth
+        for cfg in (SpaceToDepth(block=2), DepthToSpace(block=3)):
+            assert LayerConfig.from_json(cfg.to_json()) == cfg
+
+    def test_resnet_s2d_stem_trains(self):
+        from deeplearning4j_tpu.models.zoo_graph import ResNet50
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        cg = ComputationGraph(ResNet50(height=32, width=32, num_classes=4,
+                                       stem="space_to_depth",
+                                       updater={"type": "adam", "lr": 1e-3})).init()
+        rs = np.random.RandomState(0)
+        x = rs.rand(4, 32, 32, 3).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 4)]
+        l0 = float(cg.fit_batch((x, y)))
+        for _ in range(3):
+            l1 = float(cg.fit_batch((x, y)))
+        assert np.isfinite(l1) and l1 < l0
