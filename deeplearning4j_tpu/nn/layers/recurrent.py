@@ -54,7 +54,24 @@ class BaseRecurrent(FeedForwardLayerConfig):
         raise NotImplementedError
 
     def _cell(self, params, x_t, carry):
-        """One timestep: (params, x_t [b,f], carry) -> new_carry."""
+        """One timestep: (params, x_t [b,f], carry) -> new_carry. Default:
+        project the single row and delegate to ``_cell_from_proj`` (cells
+        that define ``_input_proj`` get this for free; others override)."""
+        proj = self._input_proj(params, x_t)
+        if proj is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} must implement _cell or _input_proj")
+        return self._cell_from_proj(params, proj, carry)
+
+    def _input_proj(self, params, x):
+        """Optional TPU fast path: project the WHOLE [b,t,f] input in one
+        [b*t,f]x[f,Z] MXU matmul up front; the scan then consumes the
+        precomputed rows via ``_cell_from_proj`` and only runs the recurrent
+        [b,h]x[h,Z] matmul per step. Return None to scan raw inputs."""
+        return None
+
+    def _cell_from_proj(self, params, zx_t, carry):
+        """One timestep from a precomputed input projection row."""
         raise NotImplementedError
 
     def _carry_output(self, carry):
@@ -66,11 +83,18 @@ class BaseRecurrent(FeedForwardLayerConfig):
 
         Masked steps pass the carry through unchanged and emit zeros — the
         single implementation of the reference's masked-RNN semantics, used
-        by every recurrent cell via the ``_cell`` hook."""
+        by every recurrent cell via the ``_cell``/``_cell_from_proj`` hooks."""
+        zx = self._input_proj(params, x)
+        if zx is not None:
+            stream = zx
+            cell = lambda c, v: self._cell_from_proj(params, v, c)
+        else:
+            stream = x
+            cell = lambda c, v: self._cell(params, v, c)
 
         def step(c, inp):
-            x_t, m_t = inp if mask is not None else (inp, None)
-            new_c = self._cell(params, x_t, c)
+            v_t, m_t = inp if mask is not None else (inp, None)
+            new_c = cell(c, v_t)
             if m_t is not None:
                 new_c = jax.tree_util.tree_map(
                     lambda n, o: _mask_step(m_t, n, o), new_c, c
@@ -80,7 +104,7 @@ class BaseRecurrent(FeedForwardLayerConfig):
                 out = self._carry_output(new_c)
             return new_c, out
 
-        xs = jnp.swapaxes(x, 0, 1)  # [time, batch, feat] for scan
+        xs = jnp.swapaxes(stream, 0, 1)  # [time, batch, feat] for scan
         if mask is not None:
             ms = jnp.swapaxes(mask.astype(x.dtype), 0, 1)
             final, outs = lax.scan(step, carry, (xs, ms))
@@ -127,14 +151,17 @@ class LSTM(BaseRecurrent):
     def _carry_output(self, carry):
         return carry[0]
 
-    def _cell(self, params, x_t, carry):
+    def _input_proj(self, params, x):
+        return x @ params["Wx"] + params["b"]
+
+    def _cell_from_proj(self, params, zx_t, carry):
         from deeplearning4j_tpu.nn import activations as A
 
         h, cell = carry
         H = self.n_out
         gate = A.get(self.gate_activation)
         act = A.get(self.activation)
-        z = x_t @ params["Wx"] + h @ params["Wh"] + params["b"]
+        z = zx_t + h @ params["Wh"]
         i = gate(z[:, 0 * H : 1 * H])
         f = gate(z[:, 1 * H : 2 * H])
         g = act(z[:, 2 * H : 3 * H])
@@ -142,6 +169,7 @@ class LSTM(BaseRecurrent):
         new_cell = f * cell + i * g
         new_h = o * act(new_cell)
         return (new_h, new_cell)
+
 
 
 @register_layer("graves_lstm")
@@ -156,7 +184,7 @@ class GravesLSTM(LSTM):
         params["peephole"] = jnp.zeros((3 * H,), dtype)  # [p_i, p_f, p_o]
         return params
 
-    def _cell(self, params, x_t, carry):
+    def _cell_from_proj(self, params, zx_t, carry):
         from deeplearning4j_tpu.nn import activations as A
 
         h, cell = carry
@@ -165,7 +193,7 @@ class GravesLSTM(LSTM):
         gate = A.get(self.gate_activation)
         p = params["peephole"]
         p_i, p_f, p_o = p[:H], p[H : 2 * H], p[2 * H :]
-        z = x_t @ params["Wx"] + h @ params["Wh"] + params["b"]
+        z = zx_t + h @ params["Wh"]
         i = gate(z[:, 0 * H : 1 * H] + cell * p_i)
         f = gate(z[:, 1 * H : 2 * H] + cell * p_f)
         g = act(z[:, 2 * H : 3 * H])
@@ -173,6 +201,7 @@ class GravesLSTM(LSTM):
         o = gate(z[:, 3 * H : 4 * H] + new_cell * p_o)
         new_h = o * act(new_cell)
         return (new_h, new_cell)
+
 
 
 @register_layer("simple_rnn")
@@ -195,8 +224,12 @@ class SimpleRnn(BaseRecurrent):
     def initial_carry(self, batch: int, dtype=jnp.float32):
         return jnp.zeros((batch, self.n_out), dtype)
 
-    def _cell(self, params, x_t, carry):
-        return self.activation_fn()(x_t @ params["Wx"] + carry @ params["Wh"] + params["b"])
+    def _input_proj(self, params, x):
+        return x @ params["Wx"] + params["b"]
+
+    def _cell_from_proj(self, params, zx_t, carry):
+        return self.activation_fn()(zx_t + carry @ params["Wh"])
+
 
 
 @register_layer("bidirectional")

@@ -432,3 +432,43 @@ class TestReviewRegressions:
         model = MultiLayerNetwork(conf).init()
         pen = float(model.layers[0].regularization_penalty(model.params[0]))
         assert pen > 0.0  # inner LSTM's l2 is not silently dropped
+
+
+class TestRnnInputProjectionHoist:
+    """Round-3 TPU optimization: the input projection is computed for all
+    timesteps in ONE matmul before the scan. Must be numerically identical
+    to the per-step cell path (masking and peepholes included)."""
+
+    @pytest.mark.parametrize("cls_name", ["LSTM", "GravesLSTM", "SimpleRnn"])
+    def test_fast_path_matches_cell_path(self, cls_name):
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn import layers as L
+
+        cls = getattr(L, cls_name)
+        layer = cls(n_out=8)
+        rs = np.random.RandomState(0)
+        p = layer.init(jax.random.PRNGKey(0), InputType.recurrent(5, 12))
+        if "peephole" in p:
+            p = dict(p)
+            p["peephole"] = jnp.asarray(rs.randn(24).astype(np.float32) * 0.3)
+        x = jnp.asarray(rs.randn(4, 12, 5).astype(np.float32))
+        mask = jnp.asarray((rs.rand(4, 12) > 0.3).astype(np.float32))
+        carry = layer.initial_carry(4, jnp.float32)
+        y_fast, c_fast = layer.apply_seq(p, x, carry, mask)
+        orig = cls._input_proj
+        try:
+            # disable only the WHOLE-SEQUENCE (3-D) projection: apply_seq
+            # then falls back to per-step _cell, which still projects rows
+            cls._input_proj = lambda self, params, xx: (
+                None if xx.ndim == 3 else orig(self, params, xx))
+            y_slow, c_slow = layer.apply_seq(
+                p, x, layer.initial_carry(4, jnp.float32), mask)
+        finally:
+            cls._input_proj = orig
+        np.testing.assert_allclose(np.asarray(y_fast), np.asarray(y_slow),
+                                   rtol=1e-5, atol=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(c_fast),
+                        jax.tree_util.tree_leaves(c_slow)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
