@@ -246,3 +246,38 @@ class TestVectorizers:
         x, y = v.vectorize("the cat", "pets", ["pets", "other"])
         assert x.shape == (v.vocab_size,)
         np.testing.assert_array_equal(y, [1.0, 0.0])
+
+
+class TestViterbiAndMovingWindow:
+    def test_viterbi_smooths_isolated_flips(self):
+        from deeplearning4j_tpu.utils.misc import Viterbi
+        v = Viterbi(states=2, meta_stability=0.95, p_correct=0.9)
+        noisy = np.array([0, 0, 0, 1, 0, 0, 1, 1, 1, 1, 0, 1, 1])
+        score, smoothed = v.decode(noisy)
+        np.testing.assert_array_equal(
+            smoothed, [0, 0, 0, 0, 0, 0, 1, 1, 1, 1, 1, 1, 1])
+        assert np.isfinite(score)
+
+    def test_viterbi_one_hot_input_and_validation(self):
+        from deeplearning4j_tpu.utils.misc import Viterbi
+        import pytest
+        v = Viterbi(states=3)
+        oh = np.eye(3)[[0, 0, 2, 2]]
+        _, path = v.decode(oh)
+        assert path.shape == (4,)
+        with pytest.raises(ValueError, match="out of range"):
+            v.decode(np.array([0, 5]))
+        with pytest.raises(ValueError):
+            Viterbi(states=1)
+
+    def test_moving_window_matrix(self):
+        from deeplearning4j_tpu.utils.misc import MovingWindowMatrix
+        m = np.arange(12).reshape(3, 4)
+        ws = MovingWindowMatrix(m, 2, 2).window_list()
+        assert len(ws) == 2 * 3
+        np.testing.assert_array_equal(ws[0], [[0, 1], [4, 5]])
+        ws_rot = MovingWindowMatrix(m, 2, 2, add_rotate=True).window_list()
+        assert len(ws_rot) == 2 * 3 * 4
+        import pytest
+        with pytest.raises(ValueError, match="exceeds"):
+            MovingWindowMatrix(m, 5, 2)
