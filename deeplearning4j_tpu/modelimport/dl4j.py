@@ -474,12 +474,23 @@ def import_dl4j_zip(path: str, input_type: Optional[InputType] = None):
 
     with zipfile.ZipFile(path) as zf:
         conf = json.loads(zf.read("configuration.json").decode("utf-8"))
-        coeff = zf.read("coefficients.bin")
+        coeff = (zf.read("coefficients.bin")
+                 if "confs" in conf else b"")  # CG path discards weights
+
+    if "vertices" in conf and "confs" not in conf:
+        # ComputationGraph zip: CONFIG import + fresh init. Weight transplant
+        # is deliberately not attempted: the reference flattens CG params in
+        # an order defined by its runtime topological sort
+        # (graph/ComputationGraph.java init), which cannot be replicated
+        # byte-exactly without a JVM to confirm — silent misassignment is
+        # worse than an honest fresh init.
+        model = _import_dl4j_graph_conf(conf, input_type)
+        model.weights_imported = False
+        return model
 
     confs = conf.get("confs") or []
     if not confs:
-        raise ValueError("configuration.json has no 'confs' — not a MultiLayerNetwork zip"
-                         " (ComputationGraph import is not yet supported)")
+        raise ValueError("configuration.json has no 'confs' — not a MultiLayerNetwork zip")
     layer_dicts: List[Tuple[str, dict]] = []
     for c in confs:
         layer = c.get("layer") or {}
@@ -541,7 +552,104 @@ def import_dl4j_zip(path: str, input_type: Optional[InputType] = None):
     model.state = tuple(new_state)
     model.opt_state = tuple(
         u.init(p) for u, p in zip(model._updaters, model.params))
+    model.weights_imported = True
     return model
+
+
+def _import_dl4j_graph_conf(conf: dict, input_type):
+    """DL4J ComputationGraphConfiguration JSON -> our ComputationGraph
+    (freshly initialized). Vertex dialect: conf/graph/GraphVertex.java:40-52
+    WRAPPER_OBJECT names; layer vertices wrap a NeuralNetConfiguration."""
+    from deeplearning4j_tpu.nn.graph import (
+        ComputationGraph,
+        ComputationGraphConfiguration,
+        ElementWiseVertex,
+        MergeVertex,
+        SubsetVertex,
+    )
+
+    inputs = list(conf.get("networkInputs") or [])
+    outputs = list(conf.get("networkOutputs") or [])
+    vertex_inputs: Dict[str, List[str]] = {
+        k: list(v) for k, v in (conf.get("vertexInputs") or {}).items()}
+    vertices = conf.get("vertices") or {}
+    if not inputs or not outputs:
+        raise ValueError("CG config lacks networkInputs/networkOutputs")
+
+    g = ComputationGraphConfiguration.builder().add_inputs(*inputs)
+    if input_type is None:
+        raise ValueError(
+            "DL4J ComputationGraph configs do not carry input dimensions — "
+            "pass input_type= (one InputType per network input)")
+    its = input_type if isinstance(input_type, (list, tuple)) else [input_type]
+    g.set_input_types(*its)
+
+    from deeplearning4j_tpu.nn.graph import (
+        L2NormalizeVertex,
+        L2Vertex,
+        ScaleVertex,
+        ShiftVertex,
+        StackVertex,
+        UnstackVertex,
+    )
+
+    def make_vertex(vtype: str, body: dict):
+        if vtype == "MergeVertex":
+            return MergeVertex()
+        if vtype == "ElementWiseVertex":
+            return ElementWiseVertex(op=str(body.get("op", "Add")).lower())
+        if vtype == "SubsetVertex":
+            return SubsetVertex(from_index=int(body.get("from", 0)),
+                                to_index=int(body.get("to", 0)))
+        if vtype == "ScaleVertex":
+            return ScaleVertex(scale=float(body.get("scaleFactor", 1.0)))
+        if vtype == "ShiftVertex":
+            return ShiftVertex(shift=float(body.get("shiftFactor", 0.0)))
+        if vtype == "StackVertex":
+            return StackVertex()
+        if vtype == "UnstackVertex":
+            return UnstackVertex(from_index=int(body.get("from", 0)),
+                                 stack_size=int(body.get("stackSize", 1)))
+        if vtype == "L2Vertex":
+            return L2Vertex(eps=float(body.get("eps", 1e-8)))
+        if vtype == "L2NormalizeVertex":
+            return L2NormalizeVertex(eps=float(body.get("eps", 1e-8)))
+        raise ValueError(f"DL4J graph vertex type {vtype!r} not supported")
+
+    # vertexInputs preserves the reference's insertion order (LinkedHashMap);
+    # add vertices in an order where inputs precede consumers
+    added = set(inputs)
+    pending = [n for n in vertex_inputs if n not in inputs]
+    updater = None
+    while pending:
+        progressed = False
+        for name in list(pending):
+            ins = vertex_inputs.get(name, [])
+            if any(i not in added for i in ins):
+                continue
+            vd = vertices.get(name)
+            if not isinstance(vd, dict) or len(vd) != 1:
+                raise ValueError(f"unparseable vertex {name!r}: {vd!r}")
+            vtype = next(iter(vd))
+            body = vd[vtype] or {}
+            if vtype == "LayerVertex":
+                layer_wrap = (body.get("layerConf") or {}).get("layer") or {}
+                if len(layer_wrap) != 1:
+                    raise ValueError(f"unparseable LayerVertex {name!r}")
+                t = next(iter(layer_wrap))
+                g.add_layer(name, dl4j_layer_to_config(t, layer_wrap[t]), *ins)
+                if updater is None:
+                    updater = _parse_updater(layer_wrap[t])
+            else:
+                g.add_vertex(name, make_vertex(vtype, body), *ins)
+            added.add(name)
+            pending.remove(name)
+            progressed = True
+        if not progressed:
+            raise ValueError(f"cyclic or dangling vertex inputs: {pending}")
+    g.set_outputs(*outputs)
+    g.updater(updater or {"type": "sgd", "lr": 0.1})
+    return ComputationGraph(g.build()).init()
 
 
 # ---------------------------------------------------------------------------
