@@ -157,8 +157,12 @@ def infer_preprocessor(from_type: InputType, to_layer) -> Optional[LayerConfig]:
         ActivationLayer,
         AlphaDropout,
         DropoutLayer,
+        ELULayer,
         GaussianDropout,
         GaussianNoise,
+        LeakyReLULayer,
+        PReLU,
+        ThresholdedReLULayer,
     )
     from deeplearning4j_tpu.nn.layers.normalization import BatchNorm, LocalResponseNormalization
     from deeplearning4j_tpu.nn.layers.pooling import GlobalPooling
@@ -174,6 +178,12 @@ def infer_preprocessor(from_type: InputType, to_layer) -> Optional[LayerConfig]:
         GaussianNoise,
         GaussianDropout,
         AlphaDropout,
+        # parameterized activations consume any rank natively (PReLU's
+        # learned alpha follows the input shape at init time)
+        LeakyReLULayer,
+        ELULayer,
+        ThresholdedReLULayer,
+        PReLU,
     )
 
     if isinstance(to_layer, shape_preserving):

@@ -78,6 +78,7 @@ def main():
     save(m, "keras_bilstm", rs.rand(4, 8, 5).astype(np.float32))
 
     make_bilstm_vec()
+    make_graph_r3()
 
 
 def make_bilstm_vec():
@@ -97,6 +98,30 @@ def make_bilstm_vec():
     m.save(os.path.join(HERE, "keras_bilstm_vec.h5"))
     np.savez(os.path.join(HERE, "keras_bilstm_vec_io.npz"), x=x, y=y)
     print("keras_bilstm_vec", x.shape, "->", y.shape)
+
+
+def make_graph_r3():
+    """Functional (graph) model exercising the round-3 converters."""
+    import numpy as np
+    from tensorflow import keras
+    from tensorflow.keras import layers as L
+
+    rs = np.random.RandomState(11)
+    inp = keras.Input((8, 8, 2), name="img")
+    a = L.Conv2D(4, 3, padding="same", name="c1")(inp)
+    a = L.LeakyReLU(negative_slope=0.15, name="lr")(a)
+    b = L.Conv2DTranspose(4, 3, strides=1, padding="same", name="dc")(a)
+    m = L.add([a, b], name="addv")
+    m2 = L.Cropping2D(((1, 1), (1, 1)), name="crop")(m)
+    f = L.Flatten(name="flat")(m2)
+    out = L.Dense(3, activation="softmax", name="head")(f)
+    model = keras.Model(inp, out)
+    x = rs.rand(4, 8, 8, 2).astype(np.float32)
+    y = model.predict(x, verbose=0)
+    model.save(os.path.join(HERE, "keras_graph_r3.h5"))
+    np.savez(os.path.join(HERE, "keras_graph_r3_io.npz"), x=x, y=y)
+    print("keras_graph_r3", x.shape, "->", y.shape)
+
 
 
 if __name__ == "__main__":

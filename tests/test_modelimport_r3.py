@@ -140,3 +140,14 @@ class TestBidirectionalVector:
                 "merge_mode": "sum",
                 "layer": {"class_name": "LSTM",
                           "config": {"units": 4, "return_sequences": False}}})
+
+
+class TestFunctionalGraphR3:
+    def test_functional_model_with_new_layers(self):
+        """Graph-path coverage for the round-3 converters: LeakyReLU,
+        Conv2DTranspose, Cropping2D inside a residual functional model."""
+        model = KerasModelImport.import_keras_model_and_weights(
+            os.path.join(FIX, "keras_graph_r3.h5"))
+        io = np.load(os.path.join(FIX, "keras_graph_r3_io.npz"))
+        got = np.asarray(model.output(io["x"]))
+        np.testing.assert_allclose(got, io["y"], rtol=1e-4, atol=1e-5)
