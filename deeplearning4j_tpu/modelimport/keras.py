@@ -249,6 +249,14 @@ def _convert_layer(class_name: str, cfg: dict, *, as_output: bool = False,
             n_out=int(cfg["units"]), activation=_act(cfg.get("activation", "tanh")),
             gate_activation=_act(cfg.get("recurrent_activation", "sigmoid")),
         )
+    if class_name == "GRU":
+        from deeplearning4j_tpu.nn.layers import GRU
+
+        return GRU(
+            n_out=int(cfg["units"]), activation=_act(cfg.get("activation", "tanh")),
+            gate_activation=_act(cfg.get("recurrent_activation", "sigmoid")),
+            reset_after=bool(cfg.get("reset_after", True)),
+        )
     if class_name == "SimpleRNN":
         return SimpleRnn(n_out=int(cfg["units"]),
                          activation=_act(cfg.get("activation", "tanh")))
@@ -345,7 +353,7 @@ def _convert_layer(class_name: str, cfg: dict, *, as_output: bool = False,
     raise UnsupportedKerasConfigurationError(f"Keras layer {class_name!r}")
 
 
-_RETURNS_SEQUENCES = ("LSTM", "SimpleRNN")
+_RETURNS_SEQUENCES = ("LSTM", "SimpleRNN", "GRU")
 
 
 def _keras_input_type(shape: Sequence[Optional[int]],
@@ -419,10 +427,33 @@ def _set_weights(layer_conf, keras_weights: List[np.ndarray], params: dict,
         if len(w) != 6:
             raise UnsupportedKerasConfigurationError(
                 f"Bidirectional expects 6 weight arrays, got {len(w)}")
-        p["fwd"] = {"Wx": jnp.asarray(w[0]), "Wh": jnp.asarray(w[1]),
-                    "b": jnp.asarray(w[2])}
-        p["bwd"] = {"Wx": jnp.asarray(w[3]), "Wh": jnp.asarray(w[4]),
-                    "b": jnp.asarray(w[5])}
+
+        def _dir(kernel, rec, bias):
+            inner = type(layer_conf.rnn).__name__
+            if inner == "GRU":
+                b = np.asarray(bias)
+                d = {"Wx": jnp.asarray(kernel), "Wh": jnp.asarray(rec)}
+                if b.ndim == 2:      # reset_after=True: [2, 3H]
+                    d["b_in"] = jnp.asarray(b[0])
+                    d["b_rec"] = jnp.asarray(b[1])
+                else:
+                    d["b_in"] = jnp.asarray(b)
+                return d
+            return {"Wx": jnp.asarray(kernel), "Wh": jnp.asarray(rec),
+                    "b": jnp.asarray(bias)}
+
+        p["fwd"] = _dir(w[0], w[1], w[2])
+        p["bwd"] = _dir(w[3], w[4], w[5])
+    elif t == "GRU":
+        p["Wx"] = jnp.asarray(w[0])
+        p["Wh"] = jnp.asarray(w[1])
+        if len(w) > 2:
+            b = np.asarray(w[2])
+            if b.ndim == 2:          # reset_after=True: [2, 3H] (input, rec)
+                p["b_in"] = jnp.asarray(b[0])
+                p["b_rec"] = jnp.asarray(b[1])
+            else:                     # reset_after=False: single [3H]
+                p["b_in"] = jnp.asarray(b)
     elif t in ("LSTM", "SimpleRnn"):
         p["Wx"] = jnp.asarray(w[0])
         p["Wh"] = jnp.asarray(w[1])

@@ -62,6 +62,7 @@ from deeplearning4j_tpu.nn.layers.recurrent import (
     Bidirectional,
     BidirectionalLastTimeStep,
     GravesLSTM,
+    GRU,
     LastTimeStep,
     LSTM,
     MaskZero,

@@ -204,6 +204,62 @@ class GravesLSTM(LSTM):
 
 
 
+@register_layer("gru")
+@dataclass
+class GRU(BaseRecurrent):
+    """Gated recurrent unit. Gate order [z, r, h] and the ``reset_after``
+    switch follow Keras (cuDNN-compatible variant when True, the default) so
+    h5 import is a direct weight copy; early DL4J shipped a (since-removed)
+    GRU layer — this restores the capability TPU-first with the same
+    hoisted-input-projection scan as LSTM."""
+
+    activation: Any = "tanh"
+    gate_activation: Any = "sigmoid"
+    reset_after: bool = True
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        n_in = self.n_in if self.n_in is not None else input_type.size
+        H = self.n_out
+        kx, kh = jax.random.split(key)
+        p = {
+            "Wx": initializers.initialize(self.weight_init, kx, (n_in, 3 * H),
+                                          n_in, H, dtype),
+            "Wh": initializers.initialize(self.weight_init, kh, (H, 3 * H),
+                                          H, H, dtype),
+            "b_in": jnp.zeros((3 * H,), dtype),
+        }
+        if self.reset_after:
+            # separate recurrent bias exists ONLY in the reset_after variant
+            # (Keras parity; without it b_rec would be redundant with b_in)
+            p["b_rec"] = jnp.zeros((3 * H,), dtype)
+        return p
+
+    def initial_carry(self, batch: int, dtype=jnp.float32):
+        return jnp.zeros((batch, self.n_out), dtype)
+
+    def _input_proj(self, params, x):
+        return x @ params["Wx"] + params["b_in"]
+
+    def _cell_from_proj(self, params, zx_t, carry):
+        from deeplearning4j_tpu.nn import activations as A
+
+        h = carry
+        H = self.n_out
+        gate = A.get(self.gate_activation)
+        act = A.get(self.activation)
+        if self.reset_after:
+            rec = h @ params["Wh"] + params["b_rec"]
+            z = gate(zx_t[:, :H] + rec[:, :H])
+            r = gate(zx_t[:, H:2 * H] + rec[:, H:2 * H])
+            hh = act(zx_t[:, 2 * H:] + r * rec[:, 2 * H:])
+        else:
+            rec_zr = h @ params["Wh"][:, :2 * H]
+            z = gate(zx_t[:, :H] + rec_zr[:, :H])
+            r = gate(zx_t[:, H:2 * H] + rec_zr[:, H:])
+            hh = act(zx_t[:, 2 * H:] + (r * h) @ params["Wh"][:, 2 * H:])
+        return z * h + (1.0 - z) * hh
+
+
 @register_layer("simple_rnn")
 @dataclass
 class SimpleRnn(BaseRecurrent):

@@ -79,6 +79,7 @@ def main():
 
     make_bilstm_vec()
     make_graph_r3()
+    make_gru()
 
 
 def make_bilstm_vec():
@@ -121,6 +122,46 @@ def make_graph_r3():
     model.save(os.path.join(HERE, "keras_graph_r3.h5"))
     np.savez(os.path.join(HERE, "keras_graph_r3_io.npz"), x=x, y=y)
     print("keras_graph_r3", x.shape, "->", y.shape)
+
+
+
+def make_gru():
+    """GRU fixtures: return_sequences both ways."""
+    import numpy as np
+    from tensorflow import keras
+    from tensorflow.keras import layers as L
+
+    rs = np.random.RandomState(13)
+    x = rs.rand(4, 6, 5).astype(np.float32)
+    m = keras.Sequential([
+        keras.Input((6, 5)),
+        L.GRU(7, return_sequences=True),
+        L.GlobalMaxPooling1D(),
+        L.Dense(3, activation="softmax"),
+    ])
+    m.save(os.path.join(HERE, "keras_gru.h5"))
+    np.savez(os.path.join(HERE, "keras_gru_io.npz"), x=x,
+             y=m.predict(x, verbose=0))
+    m2 = keras.Sequential([
+        keras.Input((6, 5)),
+        L.GRU(5),
+        L.Dense(3, activation="softmax"),
+    ])
+    m2.save(os.path.join(HERE, "keras_gru_vec.h5"))
+    np.savez(os.path.join(HERE, "keras_gru_vec_io.npz"), x=x,
+             y=m2.predict(x, verbose=0))
+    rs2 = np.random.RandomState(17)
+    x2 = rs2.rand(4, 6, 4).astype(np.float32)
+    m3 = keras.Sequential([
+        keras.Input((6, 4)),
+        L.Bidirectional(L.GRU(5, return_sequences=True)),
+        L.GlobalAveragePooling1D(),
+        L.Dense(3, activation="softmax"),
+    ])
+    m3.save(os.path.join(HERE, "keras_bigru.h5"))
+    np.savez(os.path.join(HERE, "keras_bigru_io.npz"), x=x2,
+             y=m3.predict(x2, verbose=0))
+    print("keras_gru fixtures written")
 
 
 

@@ -151,3 +151,36 @@ class TestFunctionalGraphR3:
         io = np.load(os.path.join(FIX, "keras_graph_r3_io.npz"))
         got = np.asarray(model.output(io["x"]))
         np.testing.assert_allclose(got, io["y"], rtol=1e-4, atol=1e-5)
+
+
+class TestGRU:
+    def test_gru_sequences_golden(self):
+        _golden("keras_gru")
+
+    def test_gru_vector_golden(self):
+        """return_sequences=False -> LastTimeStep wrap."""
+        _golden("keras_gru_vec")
+
+    def test_gru_serde_and_gradcheck(self):
+        from deeplearning4j_tpu.nn.config import LayerConfig
+        from deeplearning4j_tpu.nn.input_type import InputType
+        from deeplearning4j_tpu.nn.layers import GRU, RnnOutputLayer
+        from deeplearning4j_tpu.nn.model import (
+            MultiLayerConfiguration, MultiLayerNetwork)
+        from deeplearning4j_tpu.utils.gradientcheck import check_gradients
+        for ra in (True, False):
+            cfg = GRU(n_out=4, reset_after=ra)
+            assert LayerConfig.from_json(cfg.to_json()) == cfg
+            conf = MultiLayerConfiguration(
+                layers=(cfg, RnnOutputLayer(n_out=2, activation="softmax")),
+                input_type=InputType.recurrent(3, 5))
+            m = MultiLayerNetwork(conf).init()
+            rs = np.random.RandomState(0)
+            x = rs.randn(3, 5, 3)
+            y = np.eye(2)[rs.randint(0, 2, (3, 5))]
+            assert check_gradients(m, x, y, subset=6), f"reset_after={ra}"
+
+    def test_bidirectional_gru_golden(self):
+        """Regression: Bidirectional(GRU) weight mapping must use GRU's
+        b_in/b_rec keys, not the LSTM-style 'b'."""
+        _golden("keras_bigru")
