@@ -118,8 +118,7 @@ class CheckpointListener(TrainingListener):
                 "filename": fname,
             }
         )
-        with open(self._index_path(), "w") as f:
-            json.dump(entries, f, indent=1)
+        self._write_index(entries)
         self._last_save_time = time.time()
         self._apply_retention(entries)
 
@@ -146,8 +145,16 @@ class CheckpointListener(TrainingListener):
                     os.remove(os.path.join(self.directory, e["filename"]))
                 except OSError:
                     pass
-        with open(self._index_path(), "w") as f:
-            json.dump(remaining, f, indent=1)
+        self._write_index(remaining)
+
+    def _write_index(self, entries: List[dict]) -> None:
+        """ATOMIC index write (temp + os.replace): a process killed mid-save
+        — or a concurrent reader polling for resume — must never observe a
+        truncated checkpointInfo.json (the preemption-recovery contract)."""
+        tmp = self._index_path() + f".{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(entries, f, indent=1)
+        os.replace(tmp, self._index_path())
 
     # -- static inspection/restore helpers ---------------------------------
     @staticmethod
