@@ -211,8 +211,9 @@ def bench_resnet50():
         out["peak_tflops"] = peak / 1e12
 
     # TPU-optimized stem variant (SpaceToDepth + 4x4/s1 — NOT the reference
-    # layout; reported separately, labeled)
-    if not SMOKE:
+    # layout; reported separately, labeled). Costs a second full compile, so
+    # it is opt-in: BENCH_S2D=1 (measured result recorded in docs/PERF.md).
+    if not SMOKE and os.environ.get("BENCH_S2D") == "1":
         cg2 = ComputationGraph(
             ResNet50(height=size, width=size, num_classes=classes,
                      dtype=dtype, stem="space_to_depth")).init()
@@ -260,18 +261,23 @@ def bench_lstm_char_rnn():
     y = jnp.asarray(np.eye(vocab, dtype=np.float32)[np.roll(ids, -1, axis=1)])
 
     step = model._get_step_fn(False)
-    st = [model.params, model.opt_state, model.state]
     rng = jax.random.PRNGKey(0)
+    # AOT-compile ONCE; the same executable serves the timing loop and the
+    # cost analysis (a second .lower().compile() would be a full recompile)
+    compiled = step.lower(model.params, model.opt_state, model.state,
+                          jnp.asarray(0, jnp.int32), rng, x, y,
+                          None, None, ()).compile()
+    st = [model.params, model.opt_state, model.state]
 
     def run(n):
         loss = None
         for i in range(n):
-            st[0], st[1], st[2], _, loss = step(
+            st[0], st[1], st[2], _, loss = compiled(
                 st[0], st[1], st[2], jnp.asarray(i, jnp.int32), rng, x, y,
                 None, None, ())
         jax.block_until_ready(loss)
 
-    dt, steps = _timed(run, warmup_steps=5, steps=100)
+    dt, steps = _timed(run, warmup_steps=5, steps=50)
     tps = steps * batch * timesteps / dt
     out = {
         "metric": "lstm_char_rnn_train_throughput",
@@ -286,9 +292,7 @@ def bench_lstm_char_rnn():
     peak = _peak_flops("bfloat16")
     if peak:
         try:
-            lowered = step.lower(st[0], st[1], st[2], jnp.asarray(0, jnp.int32),
-                                 rng, x, y, None, None, ())
-            ca = lowered.compile().cost_analysis()
+            ca = compiled.cost_analysis()
             ca = ca[0] if isinstance(ca, list) else ca
             xla_flops = float(ca.get("flops", 0.0))
             if xla_flops > 0:
