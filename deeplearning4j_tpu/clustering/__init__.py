@@ -16,6 +16,7 @@ from deeplearning4j_tpu.clustering.knn import knn_search, pairwise_distance
 from deeplearning4j_tpu.clustering.kmeans import KMeansClustering, Cluster, ClusterSet
 from deeplearning4j_tpu.clustering.lsh import RandomProjectionLSH
 from deeplearning4j_tpu.clustering.server import NearestNeighborsServer
+from deeplearning4j_tpu.clustering.sptree import QuadTree, SpTree
 from deeplearning4j_tpu.clustering.trees import KDTree, VPTree
 from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne, Tsne
 
@@ -28,6 +29,8 @@ __all__ = [
     "RandomProjectionLSH",
     "KDTree",
     "VPTree",
+    "QuadTree",
+    "SpTree",
     "BarnesHutTsne",
     "Tsne",
     "NearestNeighborsServer",

@@ -1,0 +1,143 @@
+"""SpTree / QuadTree Barnes-Hut trees (reference sptree/SpTree.java,
+quadtree/QuadTree.java): structure invariants, theta=0 exactness against a
+dense gradient, and theta>0 approximation quality."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering.sptree import (
+    QuadTree, SpTree, barnes_hut_gradient)
+
+
+def _sparse_p(n, rs, k=5):
+    """Symmetric-ish sparse P in CSR over k random neighbors per row."""
+    rows, cols, vals = [0], [], []
+    for i in range(n):
+        nbrs = rs.choice([j for j in range(n) if j != i], size=k, replace=False)
+        cols.extend(nbrs.tolist())
+        vals.extend(rs.rand(k).tolist())
+        rows.append(len(cols))
+    return (np.asarray(rows, np.int64), np.asarray(cols, np.int64),
+            np.asarray(vals, np.float64) / np.sum(vals))
+
+
+def _dense_gradient(y, row_p, col_p, val_p):
+    n = y.shape[0]
+    d2 = ((y[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+    num = 1.0 / (1.0 + d2)
+    np.fill_diagonal(num, 0.0)
+    z = num.sum()
+    pos = np.zeros_like(y)
+    for i in range(n):
+        for ptr in range(row_p[i], row_p[i + 1]):
+            j = col_p[ptr]
+            pos[i] += val_p[ptr] * num[i, j] * (y[i] - y[j])
+    rep = np.zeros_like(y)
+    for i in range(n):
+        rep[i] = ((num[i] ** 2)[:, None] * (y[i] - y)).sum(0) / z
+    return 4.0 * (pos - rep)
+
+
+class TestStructure:
+    def test_cum_size_and_center_of_mass(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(64, 3)
+        t = SpTree(x)
+        assert t.cum_size == 64
+        np.testing.assert_allclose(t.center_of_mass, x.mean(0), atol=1e-9)
+        assert t.depth() > 1
+
+    def test_children_partition_points(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(40, 2)
+        t = QuadTree(x)
+        kids = [t.north_west, t.north_east, t.south_west, t.south_east]
+        assert all(k is not None for k in kids)
+        assert sum(k.cum_size for k in kids) == 40
+
+    def test_duplicate_points_stack_on_leaf(self):
+        x = np.array([[0.0, 0.0], [0.0, 0.0], [1.0, 1.0]])
+        t = QuadTree(x)
+        assert t.cum_size == 3  # no infinite subdivision on duplicates
+
+    def test_quadtree_rejects_3d(self):
+        with pytest.raises(ValueError):
+            QuadTree(np.zeros((4, 3)))
+
+
+class TestForces:
+    def test_theta0_matches_dense_gradient(self):
+        rs = np.random.RandomState(2)
+        n = 30
+        y = rs.randn(n, 2)
+        row_p, col_p, val_p = _sparse_p(n, rs)
+        g_tree = barnes_hut_gradient(y, row_p, col_p, val_p, theta=0.0)
+        g_dense = _dense_gradient(y, row_p, col_p, val_p)
+        np.testing.assert_allclose(g_tree, g_dense, rtol=1e-7, atol=1e-10)
+
+    def test_theta_half_approximates(self):
+        rs = np.random.RandomState(3)
+        n = 120
+        y = rs.randn(n, 2) * 3.0
+        row_p, col_p, val_p = _sparse_p(n, rs)
+        g_ex = _dense_gradient(y, row_p, col_p, val_p)
+
+        def rel(theta):
+            g_bh = barnes_hut_gradient(y, row_p, col_p, val_p, theta=theta)
+            return np.linalg.norm(g_bh - g_ex) / np.linalg.norm(g_ex)
+
+        r02, r05 = rel(0.2), rel(0.5)
+        assert r05 < 0.10, r05          # usable approximation at theta=0.5
+        assert r02 < r05                # error shrinks as theta -> 0
+
+    def test_sum_q_matches_z(self):
+        rs = np.random.RandomState(4)
+        y = rs.randn(25, 2)
+        tree = SpTree(y)
+        total = sum(tree.compute_non_edge_forces(i, 0.0)[1] for i in range(25))
+        d2 = ((y[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+        num = 1.0 / (1.0 + d2)
+        np.fill_diagonal(num, 0.0)
+        assert abs(total - num.sum()) < 1e-7 * num.sum()
+
+
+class TestBarnesHutTsnePath:
+    def test_bh_method_separates_clusters(self):
+        from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne
+
+        rs = np.random.RandomState(0)
+        a = rs.randn(30, 8) * 0.3
+        b = rs.randn(30, 8) * 0.3 + 6.0
+        x = np.vstack([a, b])
+        ts = BarnesHutTsne(theta=0.5, method="barnes_hut", perplexity=10.0,
+                           n_iter=200, stop_lying_iteration=50, seed=7)
+        y = ts.fit_transform(x)
+        assert y.shape == (60, 2) and np.all(np.isfinite(y))
+        ca, cb = y[:30].mean(0), y[30:].mean(0)
+        spread = max(y[:30].std(), y[30:].std())
+        assert np.linalg.norm(ca - cb) > 2.0 * spread
+
+    def test_bad_method_rejected(self):
+        from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne
+
+        with pytest.raises(ValueError):
+            BarnesHutTsne(method="approximate")
+
+
+class TestDegenerateGeometry:
+    def test_near_duplicate_points_do_not_recurse_forever(self):
+        x = np.array([[0.0, 0.0], [1e-13, 0.0], [1.0, 1.0]])
+        t = QuadTree(x)  # must terminate (stacks the near-duplicates)
+        assert t.cum_size == 3
+
+    def test_many_coincident_points_sparse_path(self):
+        from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne
+
+        rs = np.random.RandomState(5)
+        # more coincident points than k+1: the self-index can be absent
+        # from its own neighbor list (tie-break by index)
+        x = np.vstack([np.zeros((15, 4)), rs.randn(20, 4) + 3.0])
+        emb = BarnesHutTsne(theta=0.5, method="barnes_hut", perplexity=3.0,
+                            n_iter=40, stop_lying_iteration=10,
+                            seed=2).fit_transform(x)
+        assert emb.shape == (35, 2) and np.all(np.isfinite(emb))
