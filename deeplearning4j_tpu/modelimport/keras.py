@@ -231,6 +231,27 @@ def _convert_layer(class_name: str, cfg: dict, *, as_output: bool = False,
         return ActivationLayer(activation=_act(cfg.get("activation")))
     if class_name == "Dropout":
         return DropoutLayer(dropout=float(cfg.get("rate", 0.5)))
+    if class_name == "Reshape":
+        from deeplearning4j_tpu.nn.preprocessors import Reshape
+
+        return Reshape(shape=tuple(int(d) for d in cfg["target_shape"]))
+    if class_name in ("SpatialDropout1D", "SpatialDropout2D"):
+        from deeplearning4j_tpu.nn.layers import SpatialDropout
+
+        return SpatialDropout(dropout=float(cfg.get("rate", 0.5)))
+    if class_name == "ZeroPadding1D":
+        from deeplearning4j_tpu.nn.layers import ZeroPadding1D
+
+        # the dataclass normalizes int-or-(l,r) itself (_pads)
+        return ZeroPadding1D(padding=cfg.get("padding", 1))
+    if class_name == "Cropping1D":
+        from deeplearning4j_tpu.nn.layers import Cropping1D
+
+        return Cropping1D(crop=cfg.get("cropping", 1))
+    if class_name == "UpSampling1D":
+        from deeplearning4j_tpu.nn.layers import Upsampling1D
+
+        return Upsampling1D(size=int(cfg.get("size", 2)))
     if class_name == "ZeroPadding2D":
         pad = cfg.get("padding", 1)
         if isinstance(pad, (list, tuple)) and isinstance(pad[0], (list, tuple)):
@@ -550,15 +571,24 @@ def _sequential_from_config(model_config: dict) -> Tuple[MultiLayerConfiguration
     _structural = ("InputLayer", "Flatten", "Dropout", "Activation",
                    "LeakyReLU", "ELU", "ThresholdedReLU", "PReLU",
                    "Cropping2D", "Permute", "RepeatVector",
-                   "GaussianNoise", "GaussianDropout", "AlphaDropout")
+                   "GaussianNoise", "GaussianDropout", "AlphaDropout",
+                   "Masking", "Reshape", "SpatialDropout1D",
+                   "SpatialDropout2D", "ZeroPadding1D", "ZeroPadding2D",
+                   "Cropping1D", "UpSampling1D", "UpSampling2D")
     last_idx = max(
         i for i, lc in enumerate(layers_cfg)
         if lc["class_name"] not in _structural
     )
     cur_it = input_type
+    pending_mask: Optional[float] = None
     for i, lc in enumerate(layers_cfg):
         cn = lc["class_name"]
         cfg = lc.get("config", {})
+        if cn == "Masking":
+            # defer: the next recurrent layer is wrapped in MaskZero so the
+            # mask is derived from its input (recurrent/MaskZeroLayer.java)
+            pending_mask = float(cfg.get("mask_value", 0.0))
+            continue
         if cn == "Flatten" and cur_it.kind == "recurrent":
             # our Dense consumes [B,T,F] natively, so no auto-preprocessor
             # flattens timesteps — honor Keras's explicit Flatten with a
@@ -582,7 +612,24 @@ def _sequential_from_config(model_config: dict) -> Tuple[MultiLayerConfiguration
             from deeplearning4j_tpu.nn.layers import LastTimeStep
 
             conv = LastTimeStep(rnn=conv)
+        if pending_mask is not None and (
+                cn in _RETURNS_SEQUENCES or cn == "Bidirectional"):
+            # MaskZero OUTERMOST: it derives the mask from its own input and
+            # passes it down, so LastTimeStep picks the last VALID step.
+            # Keras propagates the mask through EVERY downstream RNN, so the
+            # wrap repeats for stacked RNNs — later layers re-derive it from
+            # the zeros our masked steps emit (mask_value 0.0, not the
+            # user's original value, which only applies to the raw input).
+            from deeplearning4j_tpu.nn.layers import MaskZero
+
+            conv = MaskZero(rnn=conv, mask_value=pending_mask)
+            pending_mask = 0.0
         our_layers.append(conv)
+        if type(conv).__module__.endswith("preprocessors"):
+            # preprocessor-module results (e.g. Keras Reshape) carry no
+            # weights; the pairing loop skips them without consuming a name
+            cur_it = conv.output_type(cur_it)
+            continue
         names.append(cfg.get("name", lc.get("name")))
         try:
             cur_it = conv.output_type(cur_it)
@@ -624,10 +671,13 @@ class KerasModelImport:
                 continue
             name = names[j]
             j += 1
-            # LastTimeStep.init delegates to the wrapped rnn, so its params
-            # dict IS the inner layer's — map weights against the inner conf
-            target = layer.rnn if type(layer).__name__ in (
-                "LastTimeStep", "BidirectionalLastTimeStep") else layer
+            # Wrapper layers (LastTimeStep, MaskZero, ...) delegate init to
+            # the wrapped rnn, so their params dict IS the innermost layer's
+            # — walk the chain and map weights against the inner conf
+            target = layer
+            while type(target).__name__ in (
+                    "LastTimeStep", "BidirectionalLastTimeStep", "MaskZero"):
+                target = target.rnn
             if name in weights:
                 new_params[i], new_state[i] = _set_weights(
                     target, weights[name], new_params[i], new_state[i]

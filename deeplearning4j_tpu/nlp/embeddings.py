@@ -138,6 +138,34 @@ def _dm_ns_step(params, doc_ids, context_win, win_mask, targets, negs, lr):
                                               if k not in ("syn0", "syn1neg")}}, loss
 
 
+def _cbow_hs_step(params, context_win, win_mask, codes, points, hmask, lr):
+    """CBOW hierarchical softmax (CBOW.java HS branch): the window MEAN
+    walks the target word's Huffman path.
+
+    context_win/win_mask: [B,W] padded window; codes/points/hmask: [B,L]
+    Huffman path of the TARGET word (bit, inner-node idx, validity).
+    """
+    syn0, syn1 = params["syn0"], params["syn1"]
+    ctx = syn0[context_win]                                # [B,W,D]
+    cnt = jnp.maximum(jnp.sum(win_mask, axis=-1, keepdims=True), 1.0)
+    h = jnp.sum(ctx * win_mask[..., None], axis=1) / cnt   # [B,D]
+    w = syn1[points]                                       # [B,L,D]
+    dot = jnp.einsum("bd,bld->bl", h, w)
+    sign = 1.0 - 2.0 * codes
+    loss = -jnp.sum(jax.nn.log_sigmoid(sign * dot) * hmask) / jnp.maximum(
+        jnp.sum(hmask), 1.0)
+    # dL/ddot of -log sigmoid((1-2c)*dot) is sigmoid(dot) - (1-c): the
+    # word2vec label is 1-code (word2vec.c: g = (1 - code - f))
+    g = (jax.nn.sigmoid(dot) - (1.0 - codes)) * hmask      # [B,L]
+    d_h = jnp.einsum("bl,bld->bd", g, w)
+    d_w = g[..., None] * h[:, None, :]
+    d_ctx = (d_h / cnt)[:, None, :] * win_mask[..., None]  # [B,W,D]
+    syn0 = syn0.at[context_win.reshape(-1)].add(-lr * d_ctx.reshape(-1, d_ctx.shape[-1]))
+    syn1 = syn1.at[points.reshape(-1)].add(-lr * d_w.reshape(-1, d_w.shape[-1]))
+    return {"syn0": syn0, "syn1": syn1, **{k: v for k, v in params.items()
+                                           if k not in ("syn0", "syn1")}}, loss
+
+
 def _sg_hs_step(params, centers, codes, points, mask, lr):
     """Skip-gram hierarchical softmax over Huffman paths.
 
@@ -150,7 +178,10 @@ def _sg_hs_step(params, centers, codes, points, mask, lr):
     dot = jnp.einsum("bd,bld->bl", c, w)
     sign = 1.0 - 2.0 * codes
     loss = -jnp.sum(jax.nn.log_sigmoid(sign * dot) * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-    g = (jax.nn.sigmoid(dot) - codes) * mask             # [B,L] (w2v's g)
+    # word2vec label is 1-code (word2vec.c: g = (1 - code - f)); the prior
+    # g = sigmoid-code trained the mirrored convention: embeddings came out
+    # isomorphic but the reported loss INCREASED while training
+    g = (jax.nn.sigmoid(dot) - (1.0 - codes)) * mask     # [B,L] (w2v's -g)
     d_c = jnp.einsum("bl,bld->bd", g, w)
     d_w = g[..., None] * c[:, None, :]
     syn0 = syn0.at[centers].add(-lr * d_c)
@@ -323,7 +354,8 @@ class SequenceVectors:
     def _jit_step(self, kind: str):
         if kind not in self._step_cache:
             fn = {"sg_ns": _sg_ns_step, "cbow_ns": _cbow_ns_step,
-                  "sg_hs": _sg_hs_step, "dm_ns": _dm_ns_step}[kind]
+                  "sg_hs": _sg_hs_step, "cbow_hs": _cbow_hs_step,
+                  "dm_ns": _dm_ns_step}[kind]
             self._step_cache[kind] = jax.jit(fn, donate_argnums=(0,))
         return self._step_cache[kind]
 
@@ -366,22 +398,32 @@ class SequenceVectors:
         seen = pairs_per_epoch * schedule_offset
         for _ in range(epochs):
             pg = _PairGenerator(self.window, keep, self._rs)
-            if self.elements_learning == "cbow" and not self.use_hs:
+            if self.elements_learning == "cbow":
                 # true CBOW (CBOW.java): the window AVERAGE predicts the
-                # center — padded [B, 2*window] windows with win_mask
-                step = self._jit_step("cbow_ns")
+                # center — padded [B, 2*window] windows with win_mask.
+                # NS and HS branches share the window batching; HS walks
+                # the CENTER word's Huffman path.
+                step = self._jit_step("cbow_hs" if self.use_hs else "cbow_ns")
                 for centers, win, wmask in _batched_windows(
                     pg.generate_windows(idx_seqs), self.batch_size, 2 * self.window
                 ):
                     frac = min(seen / total_pairs_est, 1.0)
                     lr = max(self.lr * (1.0 - frac), self.min_lr)
                     seen += len(centers)
-                    negs = self._draw_negatives(table, (len(centers), self.negative))
-                    self.params, _ = step(
-                        self.params, jnp.asarray(win), jnp.asarray(wmask),
-                        jnp.asarray(centers), jnp.asarray(negs),
-                        jnp.asarray(lr, jnp.float32),
-                    )
+                    if self.use_hs:
+                        self.params, _ = step(
+                            self.params, jnp.asarray(win), jnp.asarray(wmask),
+                            codes_j[centers], points_j[centers], hmask_j[centers],
+                            jnp.asarray(lr, jnp.float32),
+                        )
+                    else:
+                        negs = self._draw_negatives(
+                            table, (len(centers), self.negative))
+                        self.params, _ = step(
+                            self.params, jnp.asarray(win), jnp.asarray(wmask),
+                            jnp.asarray(centers), jnp.asarray(negs),
+                            jnp.asarray(lr, jnp.float32),
+                        )
                 continue
             for centers, contexts in _batched(pg.generate(idx_seqs), self.batch_size):
                 frac = min(seen / total_pairs_est, 1.0)

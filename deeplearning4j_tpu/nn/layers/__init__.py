@@ -21,11 +21,13 @@ from deeplearning4j_tpu.nn.layers.core import (
     Permute,
     PReLU,
     RepeatVector,
+    SpatialDropout,
     ThresholdedReLULayer,
 )
 from deeplearning4j_tpu.nn.layers.convolution import (
     Conv1D,
     Conv2D,
+    Cropping1D,
     Cropping2D,
     Deconv2D,
     DepthToSpace,
@@ -34,7 +36,9 @@ from deeplearning4j_tpu.nn.layers.convolution import (
     SpaceToDepth,
     Subsampling1D,
     Subsampling2D,
+    Upsampling1D,
     Upsampling2D,
+    ZeroPadding1D,
     ZeroPadding2D,
 )
 from deeplearning4j_tpu.nn.layers.normalization import BatchNorm, LayerNorm, LocalResponseNormalization
@@ -87,10 +91,14 @@ __all__ = [
     "Deconv2D",
     "DepthwiseConv2D",
     "SeparableConv2D",
+    "SpatialDropout",
     "Subsampling1D",
     "Subsampling2D",
+    "Upsampling1D",
     "Upsampling2D",
+    "ZeroPadding1D",
     "ZeroPadding2D",
+    "Cropping1D",
     "BatchNorm",
     "LayerNorm",
     "MultiHeadAttention",

@@ -420,6 +420,91 @@ class Upsampling2D(LayerConfig):
         return y, state
 
 
+@register_layer("upsampling1d")
+@dataclass
+class Upsampling1D(LayerConfig):
+    """Temporal nearest-neighbor upsampling over [B,T,F]
+    (Upsampling1D.java)."""
+
+    size: int = 2
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.timesteps
+        return InputType.recurrent(
+            input_type.size, t * int(self.size) if t is not None else None)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return jnp.repeat(x, int(self.size), axis=1), state
+
+    def propagate_mask(self, mask, input_type):
+        if mask is None:
+            return None
+        return jnp.repeat(mask, int(self.size), axis=1)
+
+
+@register_layer("zero_padding1d")
+@dataclass
+class ZeroPadding1D(LayerConfig):
+    """Temporal zero padding over [B,T,F] (ZeroPadding1DLayer.java).
+    padding: (left, right) or symmetric int."""
+
+    padding: Any = (1, 1)
+
+    def _pads(self):
+        p = self.padding
+        if isinstance(p, (tuple, list)):
+            return int(p[0]), int(p[1])
+        return int(p), int(p)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        l, r = self._pads()
+        t = input_type.timesteps
+        return InputType.recurrent(
+            input_type.size, t + l + r if t is not None else None)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        l, r = self._pads()
+        return jnp.pad(x, ((0, 0), (l, r), (0, 0))), state
+
+    def propagate_mask(self, mask, input_type):
+        if mask is None:
+            return None
+        l, r = self._pads()
+        return jnp.pad(mask, ((0, 0), (l, r)), constant_values=1.0)
+
+
+@register_layer("cropping1d")
+@dataclass
+class Cropping1D(LayerConfig):
+    """Temporal cropping over [B,T,F] (Cropping1D.java).
+    crop: (left, right) or symmetric int."""
+
+    crop: Any = (0, 0)
+
+    def _crops(self):
+        c = self.crop
+        if isinstance(c, (tuple, list)):
+            return int(c[0]), int(c[1])
+        return int(c), int(c)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        l, r = self._crops()
+        t = input_type.timesteps
+        return InputType.recurrent(
+            input_type.size, t - l - r if t is not None else None)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        l, r = self._crops()
+        t = x.shape[1]
+        return x[:, l: t - r, :], state
+
+    def propagate_mask(self, mask, input_type):
+        if mask is None:
+            return None
+        l, r = self._crops()
+        return mask[:, l: mask.shape[1] - r]
+
+
 @register_layer("zero_padding2d")
 @dataclass
 class ZeroPadding2D(LayerConfig):

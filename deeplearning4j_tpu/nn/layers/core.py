@@ -215,6 +215,29 @@ class DropoutLayer(LayerConfig):
         return self.maybe_dropout_input(x, train, rng), state
 
 
+@register_layer("spatial_dropout")
+@dataclass
+class SpatialDropout(LayerConfig):
+    """Channel-wise (spatial) dropout (conf/dropout/SpatialDropout.java):
+    drops ENTIRE feature maps — one Bernoulli draw per [batch, channel],
+    broadcast over the spatial/temporal axes. Inverted scaling, identity at
+    inference. Works on [B,H,W,C] (SpatialDropout2D) and [B,T,C]
+    (SpatialDropout1D) inputs alike: every axis between batch and channel
+    is broadcast."""
+
+    dropout: float = 0.5
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        p = float(self.dropout)
+        if not train or p <= 0.0:
+            return x, state
+        if rng is None:
+            raise ValueError("SpatialDropout requires an rng key in training mode")
+        shape = (x.shape[0],) + (1,) * (x.ndim - 2) + (x.shape[-1],)
+        keep = jax.random.bernoulli(rng, 1.0 - p, shape)
+        return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype), state
+
+
 @register_layer("gaussian_noise")
 @dataclass
 class GaussianNoise(LayerConfig):

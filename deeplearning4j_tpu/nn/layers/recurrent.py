@@ -412,7 +412,21 @@ class BidirectionalLastTimeStep(LayerConfig):
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         y, _ = self.rnn.apply(params, {}, x, train=train, rng=rng, mask=mask)
         H = y.shape[-1] // 2
-        return jnp.concatenate([y[:, -1, :H], y[:, 0, H:]], axis=-1), state
+        if mask is None:
+            return jnp.concatenate([y[:, -1, :H], y[:, 0, H:]], axis=-1), state
+        # masked: fwd half at the LAST valid step, bwd half at the FIRST
+        # valid step (= the backward RNN's final state after flip-back;
+        # masked steps emit zeros, so the literal endpoints would be wrong
+        # for padded sequences)
+        T = y.shape[1]
+        rev = jnp.flip(mask > 0, axis=1)
+        last_idx = (T - 1 - jnp.argmax(rev, axis=1)).astype(jnp.int32)
+        first_idx = jnp.argmax(mask > 0, axis=1).astype(jnp.int32)
+        fwd = jnp.take_along_axis(
+            y[..., :H], last_idx[:, None, None], axis=1)[:, 0, :]
+        bwd = jnp.take_along_axis(
+            y[..., H:], first_idx[:, None, None], axis=1)[:, 0, :]
+        return jnp.concatenate([fwd, bwd], axis=-1), state
 
     def propagate_mask(self, mask, input_type):
         return None

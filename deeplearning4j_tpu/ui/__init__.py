@@ -13,14 +13,42 @@ external JS, works air-gapped), plus the same attach() surface so training
 jobs stream into storage and the page re-renders on demand.
 """
 
+from deeplearning4j_tpu.ui.components import (
+    ChartHistogram,
+    ChartHorizontalBar,
+    ChartLine,
+    ChartScatter,
+    ChartStackedArea,
+    ChartTimeline,
+    Component,
+    ComponentDiv,
+    ComponentTable,
+    ComponentText,
+    render_html,
+    save_html,
+)
+from deeplearning4j_tpu.ui.convolutional import ConvolutionalIterationListener
 from deeplearning4j_tpu.ui.stats import StatsListener
 from deeplearning4j_tpu.ui.storage import FileStatsStorage, InMemoryStatsStorage, StatsStorage
 from deeplearning4j_tpu.ui.server import UIServer
 
 __all__ = [
     "StatsListener",
+    "ConvolutionalIterationListener",
     "StatsStorage",
     "InMemoryStatsStorage",
     "FileStatsStorage",
     "UIServer",
+    "Component",
+    "ChartLine",
+    "ChartScatter",
+    "ChartHistogram",
+    "ChartHorizontalBar",
+    "ChartStackedArea",
+    "ChartTimeline",
+    "ComponentText",
+    "ComponentTable",
+    "ComponentDiv",
+    "render_html",
+    "save_html",
 ]

@@ -1,0 +1,78 @@
+"""Generate round-3b Keras golden fixtures: shape-op stragglers and
+Masking->MaskZero (run once; outputs committed).
+
+    python tests/fixtures/make_keras_fixtures_r3b.py
+"""
+
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    from tensorflow import keras
+    from tensorflow.keras import layers as L
+
+    rs = np.random.RandomState(0)
+
+    def save(model, name, x):
+        y = model.predict(x, verbose=0)
+        model.save(os.path.join(HERE, f"{name}.h5"))
+        np.savez(os.path.join(HERE, f"{name}_io.npz"), x=x, y=y)
+        print(name, x.shape, "->", y.shape)
+
+    # 1. Reshape + 1-D pad/crop/upsample + SpatialDropout (identity at
+    # inference) + GlobalMaxPooling1D
+    m = keras.Sequential([
+        keras.Input((12,)),
+        L.Dense(12, activation="relu"),
+        L.Reshape((4, 3)),
+        L.ZeroPadding1D(1),
+        L.Conv1D(5, 3, activation="tanh"),
+        L.SpatialDropout1D(0.4),
+        L.UpSampling1D(2),
+        L.Cropping1D((1, 0)),
+        L.GlobalMaxPooling1D(),
+        L.Dense(4, activation="softmax"),
+    ])
+    save(m, "keras_shape_ops", rs.rand(6, 12).astype(np.float32))
+
+    # 2. Masking -> LSTM(return_sequences=False): zero-padded tails must be
+    # skipped (state carried through), final valid step returned
+    m = keras.Sequential([
+        keras.Input((7, 3)),
+        L.Masking(mask_value=0.0),
+        L.LSTM(6, return_sequences=False),
+        L.Dense(3, activation="softmax"),
+    ])
+    x = rs.rand(5, 7, 3).astype(np.float32) + 0.1  # keep real steps nonzero
+    lengths = [7, 4, 5, 2, 6]
+    for b, t in enumerate(lengths):
+        x[b, t:] = 0.0
+    save(m, "keras_masking_lstm", x)
+
+    # 3. Masking -> STACKED LSTMs: the mask must reach the second RNN
+    m = keras.Sequential([
+        keras.Input((7, 3)),
+        L.Masking(mask_value=0.0),
+        L.LSTM(5, return_sequences=True),
+        L.LSTM(4, return_sequences=False),
+        L.Dense(3, activation="softmax"),
+    ])
+    save(m, "keras_masking_stacked", x)
+
+    # 4. Masking -> Bidirectional(LSTM, return_sequences=False): fwd half
+    # must end at the last VALID step, bwd half at the first valid step
+    m = keras.Sequential([
+        keras.Input((7, 3)),
+        L.Masking(mask_value=0.0),
+        L.Bidirectional(L.LSTM(4, return_sequences=False)),
+        L.Dense(3, activation="softmax"),
+    ])
+    save(m, "keras_masking_bilstm", x)
+
+
+if __name__ == "__main__":
+    main()

@@ -100,3 +100,113 @@ class TestNode2Vec:
                          for i in range(3) for j in range(1, 4)])
         assert same > cross, (same, cross)
         assert n2v.get_vertex_vector(3) is not None
+
+
+class TestCbowHierarchicalSoftmax:
+    """CBOW + HS (CBOW.java HS branch) — previously routed to skip-gram."""
+
+    def test_cbow_hs_gradients_match_autodiff(self):
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nlp.embeddings import _cbow_hs_step
+
+        rs = np.random.RandomState(0)
+        V, D, B, W, L = 12, 6, 4, 5, 4
+        syn0 = jnp.asarray(rs.randn(V, D).astype(np.float32) * 0.3)
+        syn1 = jnp.asarray(rs.randn(V - 1, D).astype(np.float32) * 0.3)
+        win = jnp.asarray(rs.randint(0, V, (B, W), dtype=np.int32))
+        wmask = jnp.asarray((rs.rand(B, W) > 0.3).astype(np.float32))
+        wmask = wmask.at[:, 0].set(1.0)  # never an empty window
+        codes = jnp.asarray(rs.randint(0, 2, (B, L)).astype(np.float32))
+        points = jnp.asarray(rs.randint(0, V - 1, (B, L), dtype=np.int32))
+        hmask = jnp.asarray((rs.rand(B, L) > 0.2).astype(np.float32))
+        lr = jnp.float32(0.1)
+
+        new, _ = _cbow_hs_step({"syn0": syn0, "syn1": syn1},
+                               win, wmask, codes, points, hmask, lr)
+
+        def loss_unnorm(s0, s1):
+            ctx = s0[win]
+            cnt = jnp.maximum(jnp.sum(wmask, axis=-1, keepdims=True), 1.0)
+            h = jnp.sum(ctx * wmask[..., None], axis=1) / cnt
+            dot = jnp.einsum("bd,bld->bl", h, s1[points])
+            sign = 1.0 - 2.0 * codes
+            return -jnp.sum(jax.nn.log_sigmoid(sign * dot) * hmask)
+
+        g0, g1 = jax.grad(loss_unnorm, argnums=(0, 1))(syn0, syn1)
+        np.testing.assert_allclose(np.asarray(new["syn0"]),
+                                   np.asarray(syn0 - lr * g0),
+                                   rtol=2e-4, atol=2e-6)
+        np.testing.assert_allclose(np.asarray(new["syn1"]),
+                                   np.asarray(syn1 - lr * g1),
+                                   rtol=2e-4, atol=2e-6)
+
+    def test_cbow_hs_trains_and_clusters_topics(self):
+        from deeplearning4j_tpu.nlp.embeddings import Word2Vec
+
+        sents = ([["cat", "kitten", "purr", "meow"],
+                  ["kitten", "cat", "feline", "purr"],
+                  ["dog", "puppy", "bark", "woof"],
+                  ["puppy", "dog", "canine", "bark"]] * 10)
+        m = Word2Vec(layer_size=16, window=3, min_word_frequency=1,
+                     use_hierarchic_softmax=True, elements_learning="cbow",
+                     epochs=8, seed=3).fit(sents)
+        assert "syn1" in m.params  # trained the HS table, not syn1neg
+        within = m.similarity("cat", "kitten")
+        across = m.similarity("cat", "bark")
+        assert within > across, (within, across)
+
+    def test_sg_hs_gradients_match_autodiff(self):
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nlp.embeddings import _sg_hs_step
+
+        rs = np.random.RandomState(1)
+        V, D, B, L = 10, 5, 6, 3
+        syn0 = jnp.asarray(rs.randn(V, D).astype(np.float32) * 0.3)
+        syn1 = jnp.asarray(rs.randn(V - 1, D).astype(np.float32) * 0.3)
+        centers = jnp.asarray(rs.randint(0, V, B, dtype=np.int32))
+        codes = jnp.asarray(rs.randint(0, 2, (B, L)).astype(np.float32))
+        points = jnp.asarray(rs.randint(0, V - 1, (B, L), dtype=np.int32))
+        mask = jnp.asarray((rs.rand(B, L) > 0.2).astype(np.float32))
+        lr = jnp.float32(0.05)
+        new, _ = _sg_hs_step({"syn0": syn0, "syn1": syn1},
+                             centers, codes, points, mask, lr)
+
+        def loss_unnorm(s0, s1):
+            dot = jnp.einsum("bd,bld->bl", s0[centers], s1[points])
+            return -jnp.sum(jax.nn.log_sigmoid((1.0 - 2.0 * codes) * dot) * mask)
+
+        g0, g1 = jax.grad(loss_unnorm, argnums=(0, 1))(syn0, syn1)
+        np.testing.assert_allclose(np.asarray(new["syn0"]),
+                                   np.asarray(syn0 - lr * g0), rtol=2e-4, atol=2e-6)
+        np.testing.assert_allclose(np.asarray(new["syn1"]),
+                                   np.asarray(syn1 - lr * g1), rtol=2e-4, atol=2e-6)
+
+    def test_hs_loss_decreases_over_epochs(self):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nlp.embeddings import Word2Vec, _sg_hs_step
+        from deeplearning4j_tpu.nlp.vocab import huffman_tables
+
+        sents = [["a", "b", "c", "d"], ["b", "a", "d", "c"]] * 8
+        m = Word2Vec(layer_size=8, window=2, min_word_frequency=1,
+                     use_hierarchic_softmax=True, epochs=0, seed=0)
+        m.build_vocab(sents)
+        m._init_params()
+        codes, points, hmask = huffman_tables(m.vocab)
+        idx = m._index_sequences(sents)
+        flat = np.concatenate(idx)
+        centers = jnp.asarray(flat[:-1].astype(np.int32))
+        ctx = flat[1:].astype(np.int32)
+        c_j, p_j, h_j = (jnp.asarray(codes[ctx]), jnp.asarray(points[ctx]),
+                         jnp.asarray(hmask[ctx]))
+        params = dict(m.params)
+        losses = []
+        for _ in range(40):
+            params, l = _sg_hs_step(params, centers, c_j, p_j, h_j,
+                                    jnp.float32(0.05))
+            losses.append(float(l))
+        assert losses[-1] < 0.6 * losses[0], losses[:3] + losses[-3:]

@@ -1,0 +1,129 @@
+"""Standalone chart/component DSL (reference deeplearning4j-ui-components:
+ChartLine/Scatter/Histogram/HorizontalBar/StackedArea/Timeline, ComponentText/
+Table/Div, StaticPageUtil.renderHTML/saveHTMLFile)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ui.components import (
+    ChartHistogram, ChartHorizontalBar, ChartLine, ChartScatter,
+    ChartStackedArea, ChartTimeline, Component, ComponentDiv, ComponentTable,
+    ComponentText, render_html, save_html)
+
+
+def _line():
+    return (ChartLine("loss")
+            .add_series("train", [0, 1, 2], [1.0, 0.5, 0.25])
+            .add_series("val", [0, 1, 2], [1.2, 0.7, 0.5]))
+
+
+class TestSerde:
+    def test_json_round_trip_every_type(self):
+        comps = [
+            _line(),
+            ChartScatter("emb").add_series("a", [0.0, 1.0], [1.0, 0.0]),
+            ChartHistogram("w").add_bin(-1, 0, 5).add_bin(0, 1, 9),
+            ChartHorizontalBar("acc").add_value("c0", 0.9).add_value("c1", 0.7),
+            ChartStackedArea("mem").add_series("heap", [0, 1], [1, 2])
+                                   .add_series("device", [0, 1], [3, 1]),
+            ChartTimeline("phases").add_lane(
+                "epoch0", [{"start": 0, "end": 5, "label": "fwd"}]),
+            ComponentText("hello"),
+            ComponentTable(header=["k", "v"], content=[["lr", "0.1"]]),
+        ]
+        for c in comps:
+            d = json.loads(c.to_json())
+            assert d["componentType"] == c.component_type
+            back = Component.from_dict(d)
+            assert back == c, type(c).__name__
+
+    def test_div_nests_children(self):
+        div = ComponentDiv(ComponentText("a"), _line())
+        back = Component.from_json(div.to_json())
+        kids = back.children()
+        assert isinstance(kids[0], ComponentText)
+        assert isinstance(kids[1], ChartLine)
+        assert kids[1].seriesNames == ["train", "val"]
+
+    def test_unknown_type_rejected(self):
+        with pytest.raises(ValueError):
+            Component.from_dict({"componentType": "ChartPie"})
+
+    def test_mismatched_series_rejected(self):
+        with pytest.raises(ValueError):
+            ChartLine("x").add_series("bad", [0, 1], [0.0])
+
+
+class TestRender:
+    def test_components_render_svg_fragments(self):
+        assert "<polyline" in _line().render()
+        assert "<circle" in ChartScatter("s").add_series(
+            "a", [0.0, 1.0], [1.0, 0.0]).render()
+        assert "<rect" in ChartHistogram("h").add_bin(0, 1, 3).render()
+        assert "<polygon" in ChartStackedArea("m").add_series(
+            "a", [0, 1], [1, 2]).render()
+        assert "<table>" in ComponentTable(header=["a"], content=[["1"]]).render()
+
+    def test_static_page(self, tmp_path):
+        page = render_html(_line(), ComponentText("note <escaped>"))
+        assert page.startswith("<!doctype html>")
+        assert "note &lt;escaped&gt;" in page
+        assert "<svg" in page
+        p = tmp_path / "page.html"
+        save_html(str(p), _line(), title="report")
+        text = p.read_text()
+        assert "<title>report</title>" in text and "<polyline" in text
+
+    def test_empty_charts_render(self):
+        # no series / no bins must not crash (division-by-zero guards)
+        assert "<svg" in ChartLine("empty").render()
+        assert "<svg" in ChartHistogram("empty").render()
+        assert "<svg" in ChartStackedArea("empty").render()
+        assert "<svg" in ChartTimeline("empty").render()
+        assert "<svg" in ChartHorizontalBar("empty").render()
+
+
+class TestConvolutionalListener:
+    def test_png_encoder_emits_valid_png(self, tmp_path):
+        import zlib
+
+        from deeplearning4j_tpu.ui.convolutional import encode_png_gray
+
+        img = (np.arange(64, dtype=np.uint8).reshape(8, 8))
+        data = encode_png_gray(img)
+        assert data.startswith(b"\x89PNG\r\n\x1a\n")
+        # decode the IDAT payload back and compare pixels (row filter 0)
+        idat = data[data.index(b"IDAT") + 4:data.index(b"IEND") - 8]
+        raw = zlib.decompress(idat)
+        rows = [raw[r * 9 + 1:(r + 1) * 9] for r in range(8)]
+        np.testing.assert_array_equal(
+            np.frombuffer(b"".join(rows), np.uint8).reshape(8, 8), img)
+
+    def test_activation_grid_tiles_channels(self):
+        from deeplearning4j_tpu.ui.convolutional import activation_grid
+
+        act = np.random.RandomState(0).rand(6, 5, 9).astype(np.float32)
+        grid = activation_grid(act, border=1)
+        assert grid.dtype == np.uint8
+        assert grid.shape == (3 * 7 + 1, 3 * 6 + 1)  # 3x3 grid of 6x5 + borders
+        assert grid.max() == 255  # per-channel normalization hits full range
+
+    def test_listener_renders_conv_layers(self, tmp_path):
+        from deeplearning4j_tpu.models import LeNet5
+        from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+        from deeplearning4j_tpu.ui.convolutional import (
+            ConvolutionalIterationListener)
+
+        model = MultiLayerNetwork(LeNet5(height=12, width=12, channels=1,
+                                         num_classes=4)).init()
+        probe = np.random.RandomState(1).rand(2, 12, 12, 1).astype(np.float32)
+        lst = ConvolutionalIterationListener(probe, str(tmp_path), frequency=5)
+        lst.iteration_done(model, 0, 1.0)   # fires (0 % 5 == 0)
+        lst.iteration_done(model, 3, 1.0)   # skipped
+        pngs = sorted(p.name for p in tmp_path.glob("*.png"))
+        assert len(pngs) >= 2  # LeNet has two conv activations
+        assert all(n.startswith("iter000000_layer") for n in pngs)
+        index = (tmp_path / "index.html").read_text()
+        assert pngs[0] in index
