@@ -581,6 +581,15 @@ def _sequential_from_config(model_config: dict) -> Tuple[MultiLayerConfiguration
     )
     cur_it = input_type
     pending_mask: Optional[float] = None
+    _rnn_classes = set(_RETURNS_SEQUENCES) | {"Bidirectional"}
+    # rnn_later[i]: does any layer AFTER index i still need the mask?
+    rnn_later = [False] * (len(layers_cfg) + 1)
+    for k in range(len(layers_cfg) - 1, -1, -1):
+        rnn_later[k] = rnn_later[k + 1] or (
+            layers_cfg[k]["class_name"] in _rnn_classes)
+    # inference-identity layers keep zeros zero, so the derived mask survives
+    _mask_transparent = ("Dropout", "SpatialDropout1D", "SpatialDropout2D",
+                         "GaussianNoise", "GaussianDropout", "AlphaDropout")
     for i, lc in enumerate(layers_cfg):
         cn = lc["class_name"]
         cfg = lc.get("config", {})
@@ -624,6 +633,14 @@ def _sequential_from_config(model_config: dict) -> Tuple[MultiLayerConfiguration
 
             conv = MaskZero(rnn=conv, mask_value=pending_mask)
             pending_mask = 0.0
+        elif (pending_mask is not None and rnn_later[i + 1]
+                and cn not in _mask_transparent):
+            # a value-transforming layer between Masking and a later RNN
+            # breaks mask derivation (padded steps stop being mask_value /
+            # zero) — refuse rather than silently diverge from Keras
+            raise UnsupportedKerasConfigurationError(
+                f"Masking followed by {cn!r} before an RNN: the derived "
+                "mask cannot survive a value-transforming layer")
         our_layers.append(conv)
         if type(conv).__module__.endswith("preprocessors"):
             # preprocessor-module results (e.g. Keras Reshape) carry no

@@ -153,3 +153,109 @@ class LabelAwareIterator:
 
     def reset(self):
         pass
+
+
+# ---------------------------------------------------------------------------
+# CJK tokenization (deeplearning4j-nlp-chinese / -japanese / -korean)
+# ---------------------------------------------------------------------------
+
+_CJK_RANGES = (
+    (0x4E00, 0x9FFF),    # CJK Unified Ideographs
+    (0x3400, 0x4DBF),    # CJK Extension A
+    (0xF900, 0xFAFF),    # CJK Compatibility Ideographs
+    (0x3040, 0x309F),    # Hiragana
+    (0x30A0, 0x30FF),    # Katakana
+    (0xAC00, 0xD7AF),    # Hangul Syllables
+    (0x1100, 0x11FF),    # Hangul Jamo
+)
+
+
+def _is_cjk(ch: str) -> bool:
+    cp = ord(ch)
+    return any(lo <= cp <= hi for lo, hi in _CJK_RANGES)
+
+
+class CJKTokenizer:
+    """Dictionary-free CJK segmentation by character bigrams.
+
+    Scope stand-in for the reference's bundled third-party analyzers
+    (deeplearning4j-nlp-chinese: ansj ~9.5K LoC, -japanese: kuromoji ~6.8K
+    LoC, -korean glue): those embed dictionary-driven morphological
+    analysis this framework deliberately does not vendor (README
+    "Deliberate descopes"). The overlapping-bigram scheme here is the
+    classic dictionary-free IR fallback (Lucene CJKAnalyzer): embedding
+    quality on CJK corpora is serviceable, morphology is not attempted.
+    Latin/digit runs inside CJK text are kept as whole tokens; a true
+    morphological analyzer can be plugged in as a ``tokenizer_factory``.
+    """
+
+    def __init__(self, text: str, preprocessor: Optional[Callable[[str], str]] = None):
+        self._tokens: List[str] = []
+        run: List[str] = []      # pending CJK character run
+        word: List[str] = []     # pending non-CJK word run
+
+        def flush_run():
+            if len(run) == 1:
+                self._tokens.append(run[0])
+            else:
+                self._tokens.extend(run[i] + run[i + 1]
+                                    for i in range(len(run) - 1))
+            run.clear()
+
+        def flush_word():
+            if word:
+                self._tokens.append("".join(word))
+                word.clear()
+
+        for ch in text:
+            if _is_cjk(ch):
+                flush_word()
+                run.append(ch)
+            elif ch.isalnum():
+                if run:
+                    flush_run()
+                word.append(ch)
+            else:
+                flush_word()
+                if run:
+                    flush_run()
+        flush_word()
+        if run:
+            flush_run()
+        if preprocessor is not None:
+            self._tokens = [t for t in (preprocessor(t) for t in self._tokens) if t]
+        self._i = 0
+
+    def has_more_tokens(self) -> bool:
+        return self._i < len(self._tokens)
+
+    def next_token(self) -> str:
+        t = self._tokens[self._i]
+        self._i += 1
+        return t
+
+    def get_tokens(self) -> List[str]:
+        return list(self._tokens)
+
+    def count_tokens(self) -> int:
+        return len(self._tokens)
+
+
+class CJKTokenizerFactory:
+    """TokenizerFactory over :class:`CJKTokenizer` (the reference's
+    ChineseTokenizerFactory / JapaneseTokenizerFactory surface)."""
+
+    def __init__(self, preprocessor: Optional[Callable[[str], str]] = None):
+        self.preprocessor = preprocessor
+
+    def set_token_pre_processor(self, pre: Callable):
+        """Same factory surface as DefaultTokenizerFactory — the two are
+        drop-in interchangeable."""
+        self.preprocessor = pre
+        return self
+
+    def create(self, text: str) -> CJKTokenizer:
+        return CJKTokenizer(text, self.preprocessor)
+
+    def tokenize(self, text: str) -> List[str]:
+        return self.create(text).get_tokens()

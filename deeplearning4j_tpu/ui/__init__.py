@@ -29,7 +29,12 @@ from deeplearning4j_tpu.ui.components import (
 )
 from deeplearning4j_tpu.ui.convolutional import ConvolutionalIterationListener
 from deeplearning4j_tpu.ui.stats import StatsListener
-from deeplearning4j_tpu.ui.storage import FileStatsStorage, InMemoryStatsStorage, StatsStorage
+from deeplearning4j_tpu.ui.storage import (
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    RemoteStatsStorageRouter,
+    StatsStorage,
+)
 from deeplearning4j_tpu.ui.server import UIServer
 
 __all__ = [
@@ -38,6 +43,7 @@ __all__ = [
     "StatsStorage",
     "InMemoryStatsStorage",
     "FileStatsStorage",
+    "RemoteStatsStorageRouter",
     "UIServer",
     "Component",
     "ChartLine",

@@ -68,6 +68,9 @@ class Component:
         return (type(self) is type(other)
                 and self.to_dict() == other.to_dict())
 
+    def __hash__(self):
+        return hash(self.to_json())
+
     def __repr__(self):
         return f"{type(self).__name__}({self.to_dict()})"
 
@@ -154,6 +157,14 @@ class ChartScatter(_SeriesChart):
 
 class ChartStackedArea(_SeriesChart):
     component_type = "ChartStackedArea"
+
+    def add_series(self, name, x_values, y_values):
+        # stacking requires one shared x grid across all series
+        if self.x and list(x_values) != list(self.x[0]):
+            raise ValueError(
+                f"stacked series {name!r} must share the first series' x "
+                f"grid ({len(self.x[0])} points)")
+        return super().add_series(name, x_values, y_values)
 
     def render(self, w: int = 640, h: int = 220, pad: int = 42) -> str:
         if not self.x:

@@ -107,6 +107,7 @@ class UIServer:
         self._httpd = None
         self._thread = None
         self.port: Optional[int] = None
+        self._remote_storage: Optional[StatsStorage] = None
 
     @classmethod
     def get_instance(cls) -> "UIServer":
@@ -122,6 +123,20 @@ class UIServer:
     def detach(self, storage: StatsStorage) -> None:
         if storage in self.storages:
             self.storages.remove(storage)
+
+    def enable_remote_listener(self, storage: Optional[StatsStorage] = None
+                               ) -> StatsStorage:
+        """Accept POSTed stats records on ``/remote`` into ``storage``
+        (reference play/.../RemoteReceiverModule.java behind
+        UIServer.enableRemoteListener). Records come from
+        `RemoteStatsStorageRouter` in the training process."""
+        if storage is None:
+            from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+            storage = InMemoryStatsStorage()
+        self._remote_storage = storage
+        self.attach(storage)
+        return storage
 
     # -- rendering ---------------------------------------------------------
     def render_html(self, refresh_seconds: int = 0) -> str:
@@ -218,6 +233,36 @@ class UIServer:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_POST(self):
+                if self.path != "/remote" or outer._remote_storage is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    payload = json.loads(self.rfile.read(n).decode("utf-8"))
+                    records = payload if isinstance(payload, list) else [payload]
+                    # validate the WHOLE batch before applying any record:
+                    # a mid-batch failure must not store a partial batch the
+                    # client will then retry in full (duplicates)
+                    if not all(isinstance(r, dict) for r in records):
+                        raise ValueError("records must be JSON objects")
+                    for rec in records:
+                        kind = rec.pop("_kind", "update")
+                        if kind == "static":
+                            outer._remote_storage.put_static_info(rec)
+                        else:
+                            outer._remote_storage.put_update(rec)
+                except Exception as e:  # any bad payload -> 400, keep serving
+                    self.send_response(400)
+                    self.end_headers()
+                    self.wfile.write(str(e).encode())
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"ok")
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self.port = self._httpd.server_address[1]

@@ -114,3 +114,69 @@ class FileStatsStorage(StatsStorage):
 
     def close(self) -> None:
         self._file.close()
+
+
+class RemoteStatsStorageRouter(StatsStorage):
+    """Posts records to a remote UIServer's ``/remote`` endpoint
+    (ui-model/.../impl/RemoteUIStatsStorageRouter.java capability): a
+    training process streams stats into a dashboard served elsewhere.
+    Implements the StatsStorage *write* surface; reads happen server-side.
+    Failures are buffered and retried on the next put (fire-and-forget —
+    training never blocks on the UI)."""
+
+    def __init__(self, url: str, timeout: float = 2.0, max_buffer: int = 4096):
+        super().__init__()
+        self.url = url.rstrip("/") + "/remote"
+        self.timeout = timeout
+        self.max_buffer = max_buffer
+        self._pending: List[dict] = []
+
+    @staticmethod
+    def _coerce(o):
+        """JSON fallback: numpy scalars/arrays and anything else become
+        plain numbers/lists/strings — a stats record must never raise out
+        of the training loop."""
+        if hasattr(o, "tolist"):
+            return o.tolist()
+        try:
+            return float(o)
+        except (TypeError, ValueError):
+            return str(o)
+
+    def _post(self, records: List[dict]) -> bool:
+        import urllib.request
+
+        try:
+            data = json.dumps(records, default=self._coerce).encode("utf-8")
+        except (TypeError, ValueError):
+            return True  # unserializable despite coercion: drop, don't
+            # retry forever — re-posting can never succeed
+        req = urllib.request.Request(
+            self.url, data=data,
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.status == 200
+        except OSError:
+            return False
+
+    def _send(self, record: dict) -> None:
+        with self._lock:
+            self._pending.append(record)
+            batch, self._pending = self._pending, []
+        if not self._post(batch):
+            with self._lock:
+                # keep for retry on the next put, bounded
+                self._pending = (batch + self._pending)[-self.max_buffer:]
+
+    def put_static_info(self, record: dict) -> None:
+        self._send(dict(record, _kind="static",
+                        timestamp=record.get("timestamp", time.time())))
+
+    def put_update(self, record: dict) -> None:
+        self._send(dict(record, _kind="update",
+                        timestamp=record.get("timestamp", time.time())))
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)

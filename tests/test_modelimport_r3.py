@@ -247,3 +247,44 @@ class TestShapeOpStragglers:
     def test_masking_bidirectional_golden(self):
         # fwd half at last VALID step, bwd half at first valid step
         _golden("keras_masking_bilstm")
+
+    def test_masking_with_intervening_dense_rejected(self):
+        import json as _json
+
+        from deeplearning4j_tpu.modelimport.keras import (
+            UnsupportedKerasConfigurationError, _sequential_from_config)
+
+        cfgjson = {
+            "class_name": "Sequential",
+            "config": {"layers": [
+                {"class_name": "InputLayer",
+                 "config": {"batch_input_shape": [None, 7, 3]}},
+                {"class_name": "Masking", "config": {"mask_value": 0.0}},
+                {"class_name": "Dense", "config": {"units": 4}},
+                {"class_name": "LSTM",
+                 "config": {"units": 5, "return_sequences": False}},
+            ]},
+        }
+        with pytest.raises(UnsupportedKerasConfigurationError,
+                           match="Masking followed by"):
+            _sequential_from_config(cfgjson)
+
+    def test_masking_through_dropout_still_imports(self):
+        from deeplearning4j_tpu.modelimport.keras import _sequential_from_config
+        from deeplearning4j_tpu.nn.layers import MaskZero
+
+        cfgjson = {
+            "class_name": "Sequential",
+            "config": {"layers": [
+                {"class_name": "InputLayer",
+                 "config": {"batch_input_shape": [None, 7, 3]}},
+                {"class_name": "Masking", "config": {"mask_value": 0.0}},
+                {"class_name": "Dropout", "config": {"rate": 0.2}},
+                {"class_name": "LSTM",
+                 "config": {"units": 5, "return_sequences": False}},
+                {"class_name": "Dense",
+                 "config": {"units": 3, "activation": "softmax"}},
+            ]},
+        }
+        conf, _ = _sequential_from_config(cfgjson)
+        assert any(isinstance(l, MaskZero) for l in conf.layers)

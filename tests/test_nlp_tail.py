@@ -210,3 +210,42 @@ class TestCbowHierarchicalSoftmax:
                                     jnp.float32(0.05))
             losses.append(float(l))
         assert losses[-1] < 0.6 * losses[0], losses[:3] + losses[-3:]
+
+
+class TestCJKTokenizer:
+    """Dictionary-free CJK bigram tokenization (stand-in for the reference's
+    ansj/kuromoji bundles, README 'Deliberate descopes')."""
+
+    def test_chinese_bigrams(self):
+        from deeplearning4j_tpu.nlp.tokenization import CJKTokenizerFactory
+
+        toks = CJKTokenizerFactory().tokenize("我爱北京天安门")
+        # overlapping bigrams over the 7-char run
+        assert toks == ["我爱", "爱北", "北京", "京天", "天安", "安门"]
+
+    def test_mixed_script_and_singletons(self):
+        from deeplearning4j_tpu.nlp.tokenization import CJKTokenizerFactory
+
+        f = CJKTokenizerFactory()
+        assert f.tokenize("GPT模型很强") == ["GPT", "模型", "型很", "很强"]
+        assert f.tokenize("猫") == ["猫"]                 # single char kept
+        assert f.tokenize("日本語 test 한국어") == [
+            "日本", "本語", "test", "한국", "국어"]
+
+    def test_tokenizer_protocol_and_w2v_integration(self):
+        from deeplearning4j_tpu.nlp.embeddings import Word2Vec
+        from deeplearning4j_tpu.nlp.tokenization import CJKTokenizerFactory
+
+        f = CJKTokenizerFactory()
+        t = f.create("北京大学")
+        out = []
+        while t.has_more_tokens():
+            out.append(t.next_token())
+        assert out == ["北京", "京大", "大学"] and t.count_tokens() == 3
+
+        corpus = ["我爱北京", "我爱上海", "北京很大", "上海很大"] * 6
+        sents = [f.tokenize(s) for s in corpus]
+        m = Word2Vec(layer_size=8, window=2, min_word_frequency=1,
+                     epochs=3, seed=0).fit(sents)
+        assert m.has_word("北京") and m.has_word("我爱")
+        assert np.all(np.isfinite(m.syn0))

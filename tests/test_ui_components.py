@@ -127,3 +127,81 @@ class TestConvolutionalListener:
         assert all(n.startswith("iter000000_layer") for n in pngs)
         index = (tmp_path / "index.html").read_text()
         assert pngs[0] in index
+
+
+class TestRemoteStatsRouter:
+    def test_remote_router_streams_into_served_storage(self):
+        from deeplearning4j_tpu.ui import RemoteStatsStorageRouter, UIServer
+
+        server = UIServer()  # fresh instance (not the singleton)
+        storage = server.enable_remote_listener()
+        server.serve(port=0)  # ephemeral port
+        try:
+            router = RemoteStatsStorageRouter(f"http://127.0.0.1:{server.port}")
+            router.put_static_info({"session_id": "s1", "model_class": "M"})
+            router.put_update({"session_id": "s1", "type_id": "StatsReport",
+                               "iteration": 0, "score": 1.25})
+            router.put_update({"session_id": "s1", "type_id": "StatsReport",
+                               "iteration": 1, "score": 0.75})
+            assert router.pending_count() == 0
+            assert storage.list_session_ids() == ["s1"]
+            ups = storage.get_all_updates("s1")
+            assert [u["iteration"] for u in ups] == [0, 1]
+            assert storage.get_static_info("s1")[0]["model_class"] == "M"
+            # records flow into the rendered dashboard
+            page = server.render_html()
+            assert "s1" in page
+        finally:
+            server.stop()
+
+    def test_remote_router_buffers_when_server_down(self):
+        from deeplearning4j_tpu.ui import RemoteStatsStorageRouter
+
+        router = RemoteStatsStorageRouter("http://127.0.0.1:9", timeout=0.2)
+        router.put_update({"session_id": "s", "iteration": 0, "score": 1.0})
+        assert router.pending_count() == 1  # kept for retry, no exception
+
+    def test_remote_router_coerces_numpy_and_bad_payload_gets_400(self):
+        import urllib.error
+        import urllib.request
+
+        from deeplearning4j_tpu.ui import RemoteStatsStorageRouter, UIServer
+
+        server = UIServer()
+        storage = server.enable_remote_listener()
+        server.serve(port=0)
+        try:
+            router = RemoteStatsStorageRouter(f"http://127.0.0.1:{server.port}")
+            router.put_update({"session_id": "s2", "iteration": 0,
+                               "hist": np.arange(3), "score": np.float32(1.5)})
+            assert router.pending_count() == 0
+            u = storage.get_all_updates("s2")[0]
+            assert u["hist"] == [0, 1, 2] and u["score"] == 1.5
+            # non-object payload -> clean 400, server keeps serving
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/remote", data=b'["x"]',
+                headers={"Content-Type": "application/json"}, method="POST")
+            try:
+                urllib.request.urlopen(req, timeout=3)
+                raise AssertionError("expected HTTP 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+            router.put_update({"session_id": "s2", "iteration": 1, "score": 1.0})
+            assert len(storage.get_all_updates("s2")) == 2
+        finally:
+            server.stop()
+
+
+class TestComponentEdgeCases:
+    def test_stacked_area_rejects_mismatched_x(self):
+        from deeplearning4j_tpu.ui.components import ChartStackedArea
+
+        c = ChartStackedArea("m").add_series("a", [0, 1, 2], [1, 1, 1])
+        with pytest.raises(ValueError, match="share the first series"):
+            c.add_series("b", [0, 1], [2, 2])
+
+    def test_components_are_hashable(self):
+        from deeplearning4j_tpu.ui.components import ChartLine, ComponentText
+
+        s = {ComponentText("a"), ComponentText("a"), ChartLine("t")}
+        assert len(s) == 2
