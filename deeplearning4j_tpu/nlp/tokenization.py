@@ -167,6 +167,7 @@ _CJK_RANGES = (
     (0x30A0, 0x30FF),    # Katakana
     (0xAC00, 0xD7AF),    # Hangul Syllables
     (0x1100, 0x11FF),    # Hangul Jamo
+    (0x20000, 0x3FFFF),  # supplementary-plane ideographs (Ext B-G + compat)
 )
 
 

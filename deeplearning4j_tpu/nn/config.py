@@ -88,6 +88,20 @@ def _decode_value(v):
     return v
 
 
+def yaml_dump(d: dict) -> str:
+    """The ONE yaml encoding used by every config class (layer, MLN, CG) —
+    keep dialect choices (sort_keys) in one place."""
+    import yaml
+
+    return yaml.safe_dump(d, sort_keys=False)
+
+
+def yaml_load(s: str) -> dict:
+    import yaml
+
+    return yaml.safe_load(s)
+
+
 def layer_from_dict(d: dict) -> "LayerConfig":
     tag = d.get("@type")
     if tag not in layer_registry:
@@ -141,6 +155,10 @@ class LayerConfig:
     def to_json(self, **kw) -> str:
         return json.dumps(self.to_dict(), **kw)
 
+    def to_yaml(self) -> str:
+        """YAML serde (reference NeuralNetConfiguration.toYaml:376 twin)."""
+        return yaml_dump(self.to_dict())
+
     @staticmethod
     def from_dict(d: dict) -> "LayerConfig":
         return layer_from_dict(d)
@@ -148,6 +166,10 @@ class LayerConfig:
     @staticmethod
     def from_json(s: str) -> "LayerConfig":
         return layer_from_dict(json.loads(s))
+
+    @staticmethod
+    def from_yaml(s: str) -> "LayerConfig":
+        return layer_from_dict(yaml_load(s))
 
     # -- shape/param contract ---------------------------------------------
     def output_type(self, input_type: InputType) -> InputType:

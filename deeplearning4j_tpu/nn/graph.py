@@ -452,6 +452,18 @@ class ComputationGraphConfiguration:
     def from_json(s: str) -> "ComputationGraphConfiguration":
         return ComputationGraphConfiguration.from_dict(json.loads(s))
 
+    def to_yaml(self) -> str:
+        """YAML twin of to_json (ComputationGraphConfiguration.toYaml)."""
+        from deeplearning4j_tpu.nn.config import yaml_dump
+
+        return yaml_dump(self.to_dict())
+
+    @staticmethod
+    def from_yaml(s: str) -> "ComputationGraphConfiguration":
+        from deeplearning4j_tpu.nn.config import yaml_load
+
+        return ComputationGraphConfiguration.from_dict(yaml_load(s))
+
     @staticmethod
     def builder() -> "GraphBuilder":
         return GraphBuilder()

@@ -106,6 +106,18 @@ class MultiLayerConfiguration:
     def from_json(s: str) -> "MultiLayerConfiguration":
         return MultiLayerConfiguration.from_dict(json.loads(s))
 
+    def to_yaml(self) -> str:
+        """YAML twin of to_json (MultiLayerConfiguration.toYaml parity)."""
+        from deeplearning4j_tpu.nn.config import yaml_dump
+
+        return yaml_dump(self.to_dict())
+
+    @staticmethod
+    def from_yaml(s: str) -> "MultiLayerConfiguration":
+        from deeplearning4j_tpu.nn.config import yaml_load
+
+        return MultiLayerConfiguration.from_dict(yaml_load(s))
+
 
 def _cast_input(x, dtype):
     """Cast a feature array to the model dtype, PRESERVING (a) integer/bool

@@ -69,6 +69,9 @@ class Component:
                 and self.to_dict() == other.to_dict())
 
     def __hash__(self):
+        # value hash over the serialized state: equal components hash equal.
+        # Caveat: components are mutable builders — finish building (all
+        # add_series/add_bin calls) BEFORE using one as a set/dict key.
         return hash(self.to_json())
 
     def __repr__(self):

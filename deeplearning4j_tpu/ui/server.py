@@ -245,9 +245,13 @@ class UIServer:
                     records = payload if isinstance(payload, list) else [payload]
                     # validate the WHOLE batch before applying any record:
                     # a mid-batch failure must not store a partial batch the
-                    # client will then retry in full (duplicates)
-                    if not all(isinstance(r, dict) for r in records):
-                        raise ValueError("records must be JSON objects")
+                    # client will then retry in full (duplicates), and a
+                    # record without session_id would poison every later
+                    # dashboard read (list_session_ids keys on it)
+                    if not all(isinstance(r, dict) and "session_id" in r
+                               for r in records):
+                        raise ValueError(
+                            "records must be JSON objects with a session_id")
                     for rec in records:
                         kind = rec.pop("_kind", "update")
                         if kind == "static":

@@ -121,8 +121,12 @@ class RemoteStatsStorageRouter(StatsStorage):
     (ui-model/.../impl/RemoteUIStatsStorageRouter.java capability): a
     training process streams stats into a dashboard served elsewhere.
     Implements the StatsStorage *write* surface; reads happen server-side.
-    Failures are buffered and retried on the next put (fire-and-forget —
-    training never blocks on the UI)."""
+
+    Fire-and-forget for real: ``put_*`` only appends to a bounded buffer;
+    a daemon worker thread drains it over HTTP, so the training loop never
+    waits on a socket (a blackholed UI host would otherwise stall every
+    iteration for the full timeout). ``flush()`` blocks until the buffer
+    drains — for shutdown or tests."""
 
     def __init__(self, url: str, timeout: float = 2.0, max_buffer: int = 4096):
         super().__init__()
@@ -130,6 +134,12 @@ class RemoteStatsStorageRouter(StatsStorage):
         self.timeout = timeout
         self.max_buffer = max_buffer
         self._pending: List[dict] = []
+        self._wake = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._stop = False
+        self._worker = threading.Thread(target=self._drain_loop, daemon=True)
+        self._worker.start()
 
     @staticmethod
     def _coerce(o):
@@ -160,14 +170,29 @@ class RemoteStatsStorageRouter(StatsStorage):
         except OSError:
             return False
 
+    def _drain_loop(self) -> None:
+        while not self._stop:
+            self._wake.wait(timeout=1.0)
+            self._wake.clear()
+            while True:
+                with self._lock:
+                    if not self._pending:
+                        self._idle.set()
+                        break
+                    self._idle.clear()
+                    batch, self._pending = self._pending, []
+                if not self._post(batch):
+                    with self._lock:
+                        # keep for retry, bounded; back off until next wake
+                        self._pending = (batch + self._pending)[-self.max_buffer:]
+                    break
+
     def _send(self, record: dict) -> None:
         with self._lock:
             self._pending.append(record)
-            batch, self._pending = self._pending, []
-        if not self._post(batch):
-            with self._lock:
-                # keep for retry on the next put, bounded
-                self._pending = (batch + self._pending)[-self.max_buffer:]
+            del self._pending[:-self.max_buffer]
+            self._idle.clear()
+        self._wake.set()
 
     def put_static_info(self, record: dict) -> None:
         self._send(dict(record, _kind="static",
@@ -176,6 +201,19 @@ class RemoteStatsStorageRouter(StatsStorage):
     def put_update(self, record: dict) -> None:
         self._send(dict(record, _kind="update",
                         timestamp=record.get("timestamp", time.time())))
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Block until the buffer drains (or timeout); True if drained."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            self._wake.set()
+            if self._idle.wait(timeout=0.05) and self.pending_count() == 0:
+                return True
+        return self.pending_count() == 0
+
+    def close(self) -> None:
+        self._stop = True
+        self._wake.set()
 
     def pending_count(self) -> int:
         with self._lock:

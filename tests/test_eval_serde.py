@@ -1,0 +1,143 @@
+"""Evaluation-family JSON serde (reference eval/serde: Evaluation.toJson/
+fromJson on every IEvaluation): exact round-trips, dtype fidelity, and
+merge-after-restore (the Spark-worker shipping pattern)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.eval import (
+    ROC, Evaluation, EvaluationBinary, EvaluationCalibration,
+    RegressionEvaluation, ROCBinary, ROCMultiClass, from_json, to_json)
+
+
+def _rand_probs(rs, n, k):
+    p = rs.rand(n, k)
+    return p / p.sum(axis=1, keepdims=True)
+
+
+class TestRoundTrip:
+    def test_evaluation(self):
+        rs = np.random.RandomState(0)
+        e = Evaluation(top_n=2)
+        y = np.eye(4)[rs.randint(0, 4, 64)]
+        e.eval(y, _rand_probs(rs, 64, 4))
+        back = Evaluation.from_json(e.to_json())
+        assert back.accuracy() == e.accuracy()
+        assert back.f1() == e.f1()
+        np.testing.assert_array_equal(back.confusion.matrix, e.confusion.matrix)
+        assert back.confusion.matrix.dtype == np.int64  # dtype fidelity
+        assert back.top_n_correct == e.top_n_correct
+
+    def test_regression(self):
+        rs = np.random.RandomState(1)
+        r = RegressionEvaluation(column_names=["a", "b"])
+        r.eval(rs.rand(32, 2), rs.rand(32, 2))
+        back = RegressionEvaluation.from_json(r.to_json())
+        for c in range(2):
+            assert back.mean_squared_error(c) == pytest.approx(
+                r.mean_squared_error(c))
+        assert back.column_names == ["a", "b"]
+
+    def test_roc_binned_and_exact(self):
+        rs = np.random.RandomState(2)
+        labels = rs.randint(0, 2, 200)
+        preds = np.clip(labels * 0.6 + rs.rand(200) * 0.4, 0, 1)
+        for bins in (100, 0):
+            roc = ROC(num_bins=bins)
+            roc.eval(labels, preds)
+            back = ROC.from_json(roc.to_json())
+            assert back.calculate_auc() == pytest.approx(roc.calculate_auc())
+            assert back.calculate_auprc() == pytest.approx(roc.calculate_auprc())
+
+    def test_roc_multiclass_and_binary_and_calibration(self):
+        rs = np.random.RandomState(3)
+        y = np.eye(3)[rs.randint(0, 3, 120)]
+        p = _rand_probs(rs, 120, 3)
+
+        m = ROCMultiClass()
+        m.eval(y, p)
+        back = ROCMultiClass.from_json(m.to_json())
+        assert back.calculate_auc(1) == pytest.approx(m.calculate_auc(1))
+
+        b = EvaluationBinary()
+        b.eval((p > 0.4).astype(float), p)
+        bb = EvaluationBinary.from_json(b.to_json())
+        np.testing.assert_array_equal(bb.tp, b.tp)
+
+        c = EvaluationCalibration()
+        c.eval(y, p)
+        cc = EvaluationCalibration.from_json(c.to_json())
+        np.testing.assert_array_equal(cc.rel_count, c.rel_count)
+
+        rb = ROCBinary()
+        rb.eval((p > 0.4).astype(float), p)
+        rbb = ROCBinary.from_json(rb.to_json())
+        assert rbb.calculate_auc(0) == pytest.approx(rb.calculate_auc(0))
+
+
+class TestMergeAfterRestore:
+    def test_shard_shipping_pattern(self):
+        """Worker evaluates a shard, ships JSON, driver merges — totals must
+        equal a single-pass evaluation."""
+        rs = np.random.RandomState(4)
+        y = np.eye(4)[rs.randint(0, 4, 128)]
+        p = _rand_probs(rs, 128, 4)
+
+        whole = Evaluation()
+        whole.eval(y, p)
+
+        e1, e2 = Evaluation(), Evaluation()
+        e1.eval(y[:64], p[:64])
+        e2.eval(y[64:], p[64:])
+        merged = Evaluation.from_json(e1.to_json())
+        merged.merge(Evaluation.from_json(e2.to_json()))
+        assert merged.accuracy() == whole.accuracy()
+        np.testing.assert_array_equal(merged.confusion.matrix,
+                                      whole.confusion.matrix)
+
+
+class TestErrors:
+    def test_wrong_class_rejected(self):
+        e = Evaluation()
+        e.eval(np.eye(2)[[0, 1]], np.eye(2)[[0, 1]] * 0.9 + 0.05)
+        with pytest.raises(ValueError, match="not a"):
+            ROC.from_json(e.to_json())
+
+    def test_non_eval_json_rejected(self):
+        with pytest.raises(ValueError):
+            from_json('{"hello": 1}')
+
+    def test_module_fn_rejects_non_eval(self):
+        with pytest.raises(TypeError):
+            to_json({"not": "an eval"})
+
+
+class TestYamlConfigSerde:
+    """YAML twins of the JSON config serde (NeuralNetConfiguration.toYaml,
+    MultiLayerConfiguration/ComputationGraphConfiguration.toYaml)."""
+
+    def test_layer_yaml_round_trip(self):
+        from deeplearning4j_tpu.nn.config import LayerConfig
+        from deeplearning4j_tpu.nn.layers import Conv2D, LSTM
+
+        for cfg in (Conv2D(n_out=8, kernel=(3, 3), convolution_mode="same"),
+                    LSTM(n_out=16, activation="tanh")):
+            assert LayerConfig.from_yaml(cfg.to_yaml()) == cfg
+
+    def test_mln_yaml_round_trip_trains(self):
+        from deeplearning4j_tpu.models import LeNet5
+        from deeplearning4j_tpu.nn.model import (
+            MultiLayerConfiguration, MultiLayerNetwork)
+
+        conf = LeNet5(height=12, width=12, channels=1, num_classes=4)
+        back = MultiLayerConfiguration.from_yaml(conf.to_yaml())
+        assert back.to_dict() == conf.to_dict()
+        MultiLayerNetwork(back).init()  # restorable config must initialize
+
+    def test_graph_yaml_round_trip(self):
+        from deeplearning4j_tpu.models.zoo_graph import ResNet50
+        from deeplearning4j_tpu.nn.graph import ComputationGraphConfiguration
+
+        conf = ResNet50(height=32, width=32, num_classes=5)
+        back = ComputationGraphConfiguration.from_yaml(conf.to_yaml())
+        assert back.to_dict() == conf.to_dict()

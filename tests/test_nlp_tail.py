@@ -249,3 +249,12 @@ class TestCJKTokenizer:
                      epochs=3, seed=0).fit(sents)
         assert m.has_word("北京") and m.has_word("我爱")
         assert np.all(np.isfinite(m.syn0))
+
+    def test_supplementary_plane_ideographs(self):
+        from deeplearning4j_tpu.nlp.tokenization import CJKTokenizerFactory
+
+        f = CJKTokenizerFactory()
+        # Ext-B ideograph U+20BB7 (variant of 吉 in 吉野家) must bigram with
+        # BMP neighbors, not merge into a Latin-word run
+        assert f.tokenize("\U00020BB7野家") == ["\U00020BB7野", "野家"]
+        assert f.tokenize("abc\U00020BB7") == ["abc", "\U00020BB7"]

@@ -143,7 +143,7 @@ class TestRemoteStatsRouter:
                                "iteration": 0, "score": 1.25})
             router.put_update({"session_id": "s1", "type_id": "StatsReport",
                                "iteration": 1, "score": 0.75})
-            assert router.pending_count() == 0
+            assert router.flush(timeout=5.0)  # async worker drains
             assert storage.list_session_ids() == ["s1"]
             ups = storage.get_all_updates("s1")
             assert [u["iteration"] for u in ups] == [0, 1]
@@ -155,11 +155,18 @@ class TestRemoteStatsRouter:
             server.stop()
 
     def test_remote_router_buffers_when_server_down(self):
+        import time
+
         from deeplearning4j_tpu.ui import RemoteStatsStorageRouter
 
         router = RemoteStatsStorageRouter("http://127.0.0.1:9", timeout=0.2)
         router.put_update({"session_id": "s", "iteration": 0, "score": 1.0})
-        assert router.pending_count() == 1  # kept for retry, no exception
+        assert not router.flush(timeout=1.0)  # cannot drain: server down
+        deadline = time.time() + 2.0  # record re-buffered for retry
+        while time.time() < deadline and router.pending_count() != 1:
+            time.sleep(0.02)
+        assert router.pending_count() == 1
+        router.close()
 
     def test_remote_router_coerces_numpy_and_bad_payload_gets_400(self):
         import urllib.error
@@ -174,7 +181,7 @@ class TestRemoteStatsRouter:
             router = RemoteStatsStorageRouter(f"http://127.0.0.1:{server.port}")
             router.put_update({"session_id": "s2", "iteration": 0,
                                "hist": np.arange(3), "score": np.float32(1.5)})
-            assert router.pending_count() == 0
+            assert router.flush(timeout=5.0)
             u = storage.get_all_updates("s2")[0]
             assert u["hist"] == [0, 1, 2] and u["score"] == 1.5
             # non-object payload -> clean 400, server keeps serving
@@ -187,6 +194,7 @@ class TestRemoteStatsRouter:
             except urllib.error.HTTPError as e:
                 assert e.code == 400
             router.put_update({"session_id": "s2", "iteration": 1, "score": 1.0})
+            assert router.flush(timeout=5.0)
             assert len(storage.get_all_updates("s2")) == 2
         finally:
             server.stop()
@@ -205,3 +213,26 @@ class TestComponentEdgeCases:
 
         s = {ComponentText("a"), ComponentText("a"), ChartLine("t")}
         assert len(s) == 2
+
+    def test_remote_endpoint_rejects_record_without_session_id(self):
+        import urllib.error
+        import urllib.request
+
+        from deeplearning4j_tpu.ui import UIServer
+
+        server = UIServer()
+        storage = server.enable_remote_listener()
+        server.serve(port=0)
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/remote",
+                data=b'{"foo": 1}',
+                headers={"Content-Type": "application/json"}, method="POST")
+            try:
+                urllib.request.urlopen(req, timeout=3)
+                raise AssertionError("expected HTTP 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+            assert storage.list_session_ids() == []  # nothing poisoned
+        finally:
+            server.stop()
