@@ -15,8 +15,7 @@ from deeplearning4j_tpu.eval.regression import RegressionEvaluation
 from deeplearning4j_tpu.eval.roc import ROC, ROCBinary, ROCMultiClass
 from deeplearning4j_tpu.eval.binary import EvaluationBinary
 from deeplearning4j_tpu.eval.calibration import EvaluationCalibration
-from deeplearning4j_tpu.eval import serde  # attaches to_json/from_json
-from deeplearning4j_tpu.eval.serde import from_json, to_json
+from deeplearning4j_tpu.eval.serde import from_json, to_json  # import runs attach()
 
 __all__ = [
     "Evaluation",

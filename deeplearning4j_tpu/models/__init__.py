@@ -5,6 +5,12 @@ config builders. Each returns a configuration whose JSON round-trips, so zoo
 models are data, not code.
 """
 
+from deeplearning4j_tpu.models.labels import (
+    BaseLabels,
+    DarknetLabels,
+    ImageNetLabels,
+    VOCLabels,
+)
 from deeplearning4j_tpu.models.pretrained import init_pretrained, pretrained_path
 from deeplearning4j_tpu.models.zoo import LeNet5, SimpleCNN, TextGenerationLSTM, TransformerLM
 from deeplearning4j_tpu.models.zoo_graph import (
@@ -24,4 +30,5 @@ __all__ = [
     "AlexNet", "VGG16", "VGG19", "ResNet50", "GoogLeNet", "Darknet19",
     "TinyYOLO", "InceptionResNetV1", "FaceNetNN4Small2",
     "init_pretrained", "pretrained_path",
+    "BaseLabels", "ImageNetLabels", "DarknetLabels", "VOCLabels",
 ]

@@ -154,6 +154,7 @@ class RemoteStatsStorageRouter(StatsStorage):
             return str(o)
 
     def _post(self, records: List[dict]) -> bool:
+        import urllib.error
         import urllib.request
 
         try:
@@ -167,7 +168,15 @@ class RemoteStatsStorageRouter(StatsStorage):
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return resp.status == 200
-        except OSError:
+        except urllib.error.HTTPError as e:
+            # 4xx = the server REJECTED the batch (e.g. missing session_id):
+            # retrying can never succeed — drop it like unserializable
+            # records. 5xx/other statuses stay retryable.
+            return 400 <= e.code < 500
+        except Exception:
+            # network errors AND protocol surprises (BadStatusLine,
+            # IncompleteRead, ... are not OSError): the drain worker must
+            # survive anything — telemetry never takes the process down
             return False
 
     def _drain_loop(self) -> None:
@@ -209,7 +218,9 @@ class RemoteStatsStorageRouter(StatsStorage):
             self._wake.set()
             if self._idle.wait(timeout=0.05) and self.pending_count() == 0:
                 return True
-        return self.pending_count() == 0
+        # _idle guard: an in-flight batch (buffer empty, worker mid-POST)
+        # must not report as drained
+        return self._idle.is_set() and self.pending_count() == 0
 
     def close(self) -> None:
         self._stop = True

@@ -236,3 +236,21 @@ class TestComponentEdgeCases:
             assert storage.list_session_ids() == []  # nothing poisoned
         finally:
             server.stop()
+
+    def test_rejected_batch_dropped_not_retried_forever(self):
+        from deeplearning4j_tpu.ui import RemoteStatsStorageRouter, UIServer
+
+        server = UIServer()
+        storage = server.enable_remote_listener()
+        server.serve(port=0)
+        try:
+            router = RemoteStatsStorageRouter(f"http://127.0.0.1:{server.port}")
+            router.put_update({"iteration": 0})  # no session_id -> server 400
+            assert router.flush(timeout=5.0)     # dropped, not stuck
+            assert router.pending_count() == 0
+            router.put_update({"session_id": "ok", "iteration": 1, "score": 2.0})
+            assert router.flush(timeout=5.0)     # later records still flow
+            assert storage.list_session_ids() == ["ok"]
+            router.close()
+        finally:
+            server.stop()

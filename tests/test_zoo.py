@@ -104,3 +104,59 @@ class TestResNet50Trains:
             m.fit_batch(((x,), (y,), None, None))
         s1 = m.score(((x,), (y,)))
         assert s1 < s0
+
+
+class TestLabels:
+    """zoo/util parity: Labels.getLabel/decodePredictions, VOC/ImageNet."""
+
+    def test_voc_labels_and_decode(self):
+        import numpy as np
+
+        from deeplearning4j_tpu.models.labels import VOCLabels
+
+        v = VOCLabels()
+        assert len(v) == 20 and v.get_label(14) == "person"
+        rs = np.random.RandomState(0)
+        p = rs.rand(3, 20)
+        p /= p.sum(axis=1, keepdims=True)
+        decoded = v.decode_predictions(p, top=3)
+        assert len(decoded) == 3 and all(len(d) == 3 for d in decoded)
+        for row, d in zip(p, decoded):
+            assert d[0][0] == int(np.argmax(row))
+            assert d[0][2] >= d[1][2] >= d[2][2]
+            assert d[0][1] == v.get_label(d[0][0])
+
+    def test_imagenet_labels_from_cache(self, tmp_path, monkeypatch):
+        import json
+
+        from deeplearning4j_tpu.models.labels import ImageNetLabels
+
+        idx = {str(i): [f"n{i:08d}", f"class_{i}"] for i in range(10)}
+        d = tmp_path / "labels"
+        d.mkdir()
+        (d / "imagenet_class_index.json").write_text(json.dumps(idx))
+        monkeypatch.setenv("DL4J_TPU_HOME", str(tmp_path))
+        labels = ImageNetLabels()
+        assert labels.get_label(3) == "class_3"
+
+    def test_missing_label_file_message(self, tmp_path, monkeypatch):
+        import pytest
+
+        from deeplearning4j_tpu.models.labels import DarknetLabels
+
+        monkeypatch.setenv("DL4J_TPU_HOME", str(tmp_path))
+        with pytest.raises(FileNotFoundError, match="air-gapped"):
+            DarknetLabels()
+
+    def test_text_file_loader_and_mismatch(self, tmp_path):
+        import numpy as np
+        import pytest
+
+        from deeplearning4j_tpu.models.labels import BaseLabels
+
+        f = tmp_path / "labels.txt"
+        f.write_text("cat\ndog\nbird\n")
+        lb = BaseLabels.from_text_file(str(f))
+        assert lb.labels == ["cat", "dog", "bird"]
+        with pytest.raises(ValueError, match="classes"):
+            lb.decode_predictions(np.ones((1, 5)))
