@@ -105,11 +105,15 @@ class BaseRecurrent(FeedForwardLayerConfig):
             return new_c, out
 
         xs = jnp.swapaxes(stream, 0, 1)  # [time, batch, feat] for scan
+        # unroll so XLA can pipeline the small recurrent matmuls across
+        # steps ([B,H]x[H,4H] alone can't fill the chip): +46% tokens/sec
+        # on the char-RNN bench at T=50 (docs/PERF.md)
+        unroll = max(1, min(8, xs.shape[0]))
         if mask is not None:
             ms = jnp.swapaxes(mask.astype(x.dtype), 0, 1)
-            final, outs = lax.scan(step, carry, (xs, ms))
+            final, outs = lax.scan(step, carry, (xs, ms), unroll=unroll)
         else:
-            final, outs = lax.scan(step, carry, xs)
+            final, outs = lax.scan(step, carry, xs, unroll=unroll)
         return jnp.swapaxes(outs, 0, 1), final
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
