@@ -1,0 +1,76 @@
+"""Generate the NATIVE-format serialization-stability fixtures (run once;
+outputs committed — regressiontest/RegressionTest080.java equivalent for
+our own zip dialect: these exact bytes must keep restoring, with identical
+outputs, in every future version).
+
+    PYTHONPATH=/root/repo JAX_PLATFORMS=cpu python tests/fixtures/make_native_fixtures.py
+"""
+
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models import LeNet5
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    from deeplearning4j_tpu.utils.serialization import save_network
+
+    rs = np.random.RandomState(0)
+
+    # 1. MLN: LeNet-5 (conv/pool/dense + adam updater state), 3 train steps
+    mln = MultiLayerNetwork(
+        LeNet5(height=12, width=12, channels=1, num_classes=4,
+               updater={"type": "adam", "lr": 1e-3})).init()
+    x = rs.rand(6, 12, 12, 1).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 6)]
+    mln.fit(DataSet(x, y), epochs=3)
+    save_network(mln, os.path.join(HERE, "native_mln_v1.zip"),
+                 save_updater=True)
+    np.savez(os.path.join(HERE, "native_mln_v1_golden.npz"),
+             x=x, y=np.asarray(mln.output(x)))
+    print("native_mln_v1.zip")
+
+    # 2. CG: small residual conv graph (BN running stats, elementwise-add
+    # fan-in, GlobalPooling) — exercises the CG zip surface at a size that
+    # can live in git
+    from deeplearning4j_tpu.nn.graph import (
+        ComputationGraphConfiguration, ElementWiseVertex)
+    from deeplearning4j_tpu.nn.input_type import InputType
+    from deeplearning4j_tpu.nn.layers import (
+        BatchNorm, Conv2D, GlobalPooling, OutputLayer)
+
+    conf = (ComputationGraphConfiguration.builder()
+            .add_inputs("in")
+            .set_input_types(InputType.convolutional(12, 12, 2))
+            .add_layer("c1", Conv2D(n_out=8, kernel=(3, 3),
+                                    convolution_mode="same",
+                                    activation="relu"), "in")
+            .add_layer("bn", BatchNorm(), "c1")
+            .add_layer("c2", Conv2D(n_out=8, kernel=(3, 3),
+                                    convolution_mode="same"), "bn")
+            .add_vertex("res", ElementWiseVertex(op="add"), "bn", "c2")
+            .add_layer("gp", GlobalPooling(pooling="avg"), "res")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "gp")
+            .set_outputs("out")
+            .updater({"type": "adam", "lr": 1e-3})
+            .build())
+    cg = ComputationGraph(conf).init()
+    xg = rs.rand(4, 12, 12, 2).astype(np.float32)
+    yg = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 4)]
+    for _ in range(2):
+        cg.fit_batch((xg, yg))
+    save_network(cg, os.path.join(HERE, "native_cg_v1.zip"),
+                 save_updater=True)
+    np.savez(os.path.join(HERE, "native_cg_v1_golden.npz"),
+             x=xg, y=np.asarray(cg.output(xg)))
+    print("native_cg_v1.zip")
+
+
+if __name__ == "__main__":
+    main()
