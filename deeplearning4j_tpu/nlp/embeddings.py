@@ -310,7 +310,11 @@ class SequenceVectors:
         min_word_frequency: int = 5,
         sample: float = 1e-3,
         epochs: int = 1,
-        batch_size: int = 512,
+        # pairs per fused device step. The step is dispatch-latency-bound
+        # below ~16K pairs (docs/PERF.md); small corpora produce smaller
+        # final batches anyway, so a large default only helps. Raise toward
+        # 65536 for maximum throughput on big corpora.
+        batch_size: int = 8192,
         elements_learning: str = "skipgram",
         seed: int = 12345,
     ):

@@ -15,17 +15,29 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 
 def main():
     from deeplearning4j_tpu.datasets.dataset import DataSet
-    from deeplearning4j_tpu.models import LeNet5
     from deeplearning4j_tpu.nn.graph import ComputationGraph
     from deeplearning4j_tpu.nn.model import MultiLayerNetwork
     from deeplearning4j_tpu.utils.serialization import save_network
 
     rs = np.random.RandomState(0)
 
-    # 1. MLN: LeNet-5 (conv/pool/dense + adam updater state), 3 train steps
-    mln = MultiLayerNetwork(
-        LeNet5(height=12, width=12, channels=1, num_classes=4,
-               updater={"type": "adam", "lr": 1e-3})).init()
+    # 1. MLN: small conv/pool/dense stack + adam updater state, 3 train
+    # steps — covers the same zip surface (coefficients, state, updater,
+    # meta, auto preprocessor) as LeNet at ~1% of the bytes (fixtures live
+    # in git forever)
+    from deeplearning4j_tpu.nn.input_type import InputType
+    from deeplearning4j_tpu.nn.layers import (
+        Conv2D, Dense, OutputLayer, Subsampling2D)
+    from deeplearning4j_tpu.nn.model import MultiLayerConfiguration
+
+    conf = MultiLayerConfiguration(
+        layers=(Conv2D(n_out=4, kernel=(3, 3), activation="relu"),
+                Subsampling2D(kernel=(2, 2), stride=(2, 2)),
+                Dense(n_out=16, activation="tanh"),
+                OutputLayer(n_out=4, activation="softmax", loss="mcxent")),
+        input_type=InputType.convolutional(12, 12, 1),
+        updater={"type": "adam", "lr": 1e-3}, seed=7)
+    mln = MultiLayerNetwork(conf).init()
     x = rs.rand(6, 12, 12, 1).astype(np.float32)
     y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 6)]
     mln.fit(DataSet(x, y), epochs=3)
