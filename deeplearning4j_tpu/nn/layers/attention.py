@@ -69,6 +69,12 @@ class MultiHeadAttention(LayerConfig):
     sequence_parallel: bool = False
     attn_dropout: float = 0.0
     weight_init: Any = "xavier"
+    # Pallas flash-attention policy (ops/flash_attention.py): "auto" uses
+    # the kernel on TPU for unmasked attention (the [T,T] scores never
+    # leave VMEM — at T=8192 the XLA path cannot even compile, PERF.md);
+    # True forces it everywhere (Pallas interpreter on CPU — slow, for
+    # tests); False always uses the XLA einsum path.
+    use_flash: Any = "auto"
 
     def output_type(self, input_type: InputType) -> InputType:
         return input_type
@@ -105,6 +111,13 @@ class MultiHeadAttention(LayerConfig):
             return ring_self_attention(
                 q, k, v, mesh, causal=self.causal, kmask=kmask, head_axis=head_axis
             )
+        if kmask is None and self.use_flash in ("auto", True):
+            from deeplearning4j_tpu.ops.flash_attention import flash_attention
+
+            on_tpu = jax.default_backend() == "tpu"
+            if self.use_flash is True or on_tpu:
+                return flash_attention(q, k, v, causal=self.causal,
+                                       interpret=not on_tpu)
         return local_attention(q, k, v, causal=self.causal, kmask=kmask)
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
@@ -140,6 +153,7 @@ class TransformerBlock(LayerConfig):
     activation: Any = "gelu"
     weight_init: Any = "xavier"
     eps: float = 1e-5
+    use_flash: Any = "auto"  # forwarded to the nested MultiHeadAttention
 
     def output_type(self, input_type: InputType) -> InputType:
         return input_type
@@ -150,6 +164,7 @@ class TransformerBlock(LayerConfig):
             causal=self.causal,
             sequence_parallel=self.sequence_parallel,
             weight_init=self.weight_init,
+            use_flash=self.use_flash,
         )
 
     def nested_param_layers(self) -> dict:

@@ -1,0 +1,13 @@
+"""Custom TPU kernels (Pallas).
+
+The compute path is XLA by design (SURVEY.md §7: "Pallas only where XLA
+underperforms"); this package holds the exceptions. Currently:
+
+- :mod:`flash_attention` — blockwise-softmax attention forward that never
+  materialises the [T, T] score matrix (the XLA path's HBM bottleneck for
+  long sequences).
+"""
+
+from deeplearning4j_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

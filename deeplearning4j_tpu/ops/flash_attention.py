@@ -1,0 +1,212 @@
+"""Flash attention — Pallas TPU kernel with online (streaming) softmax.
+
+The XLA attention path (`parallel/ring.py local_attention`) materialises
+the [B, H, T, T] score matrix in HBM; at long T that traffic dominates
+(the framework's ResNet-style roofline analysis, docs/PERF.md, shows HBM
+bandwidth is the binding resource on this chip). This kernel computes
+attention blockwise in VMEM — scores never leave the chip — using the
+standard streaming-softmax recurrence (running max m, normaliser l,
+rescaled accumulator), one (batch*head, q-block) program per grid cell
+looping over key blocks.
+
+Beyond-reference scope: the reference (DL4J 0.9.2) has no attention layer
+at all (SURVEY.md §5.7); this accelerates the framework's TransformerLM
+extension. Training uses a custom VJP whose backward recomputes attention
+with plain XLA ops from the saved q/k/v (rematerialisation — the forward
+saves no [T, T] intermediates, so the backward rebuilds them; exact
+gradients of the same math).
+
+CPU/tests: ``interpret=True`` runs the identical kernel in the Pallas
+interpreter; the layer's default ("auto") uses the kernel only on TPU and
+falls back to the XLA path elsewhere and for masked/dropout variants.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on CPU builds too; guard for safety
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+_NEG_BIG = -1e30
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
+            t_real: int, t_pad: int, causal: bool, scale: float):
+    """One q-block vs all key blocks. Refs: q [1, block_q, D];
+    k/v [1, t_pad, D]; o [1, block_q, D]."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale                     # [bq, D]
+    d = q.shape[-1]
+    q_pos = qi * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, 1), 0)                              # [bq, 1]
+
+    m0 = jnp.full((block_q, 1), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
+        k_pos = kb * block_k + lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)                          # [1, bk]
+        valid = k_pos < t_real
+        if causal:
+            valid = jnp.logical_and(valid, k_pos <= q_pos)
+        s = jnp.where(valid, s, _NEG_BIG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                                   # [bq, bk]
+        alpha = jnp.exp(m - m_new)                               # [bq, 1]
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    n_kb = t_pad // block_k
+    if causal:
+        # key blocks strictly above the diagonal contribute nothing:
+        # stop after the block containing this q-block's last position
+        n_kb = jnp.minimum(n_kb, (qi + 1) * block_q // block_k
+                           + (1 if block_q % block_k else 0))
+    m, l, acc = lax.fori_loop(0, n_kb, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_raw(q, k, v, causal: bool, block_q: int, block_k: int,
+               interpret: bool):
+    """q/k/v: [B, T, H, D] -> [B, T, H, D]. Forward only."""
+    B, T, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    bq = min(block_q, max(T, 1))
+    bk = min(block_k, max(T, 1))
+    t_pad = _cdiv(T, bq) * bq
+    t_pad = _cdiv(t_pad, bk) * bk
+
+    def to_bh(x):
+        x = jnp.swapaxes(x, 1, 2).reshape(B * H, T, D)
+        if t_pad != T:
+            x = jnp.pad(x, ((0, 0), (0, t_pad - T), (0, 0)))
+        return x
+
+    qt, kt, vt = to_bh(q), to_bh(k), to_bh(v)
+    grid = (B * H, t_pad // bq)
+    kernel = functools.partial(
+        _kernel, block_q=bq, block_k=bk, t_real=T, t_pad=t_pad,
+        causal=causal, scale=scale)
+    kw = {}
+    if _VMEM is not None and not interpret:
+        kw["memory_space"] = _VMEM
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0), **kw),
+            pl.BlockSpec((1, t_pad, D), lambda bh, qi: (bh, 0, 0), **kw),
+            pl.BlockSpec((1, t_pad, D), lambda bh, qi: (bh, 0, 0), **kw),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0), **kw),
+        out_shape=jax.ShapeDtypeStruct((B * H, t_pad, D), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    out = out[:, :T].reshape(B, H, T, D)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _reference(q, k, v, causal: bool):
+    """The same math in plain XLA ops — used by the equivalence tests."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk",
+                   q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if causal:
+        T = q.shape[1]
+        msk = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(msk[None, None], s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _reference_chunked(q, k, v, causal: bool, chunk: int = 128):
+    """Attention computed q-chunk-at-a-time with ``lax.map`` — identical
+    math to :func:`_reference`, but only [B, H, chunk, T] scores exist at
+    once. The custom VJP differentiates THIS function, so the backward is
+    memory-bounded too (vjp of lax.map is a scan with per-chunk residuals)
+    and training works at the long T the flash forward enables."""
+    B, T, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    n = _cdiv(T, chunk)
+    t_pad = n * chunk
+    qp = jnp.pad(q, ((0, 0), (0, t_pad - T), (0, 0), (0, 0)))
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    k_pos = jnp.arange(T)
+
+    def one_chunk(ci):
+        qc = lax.dynamic_slice_in_dim(qp, ci * chunk, chunk, axis=1)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qc.astype(jnp.float32), kf) * scale
+        q_pos = ci * chunk + jnp.arange(chunk)
+        valid = jnp.ones((chunk, T), bool)
+        if causal:
+            valid = k_pos[None, :] <= q_pos[:, None]
+        s = jnp.where(valid[None, None], s, _NEG_BIG)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, vf)        # [B,chunk,H,D]
+
+    out = lax.map(one_chunk, jnp.arange(n))                # [n,B,chunk,H,D]
+    out = jnp.moveaxis(out, 0, 1).reshape(B, t_pad, H, D)
+    return out[:, :T].astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_raw(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_raw(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    # rematerialise CHUNKED (never a full [T,T] matrix — the backward must
+    # stay memory-bounded or long-T training dies exactly like the XLA
+    # path the forward kernel replaces)
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _reference_chunked(q_, k_, v_, causal), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """Blockwise flash attention over [B, T, H, D] (differentiable).
+
+    Forward runs the Pallas kernel (never materialises [T, T]); backward
+    recomputes with XLA ops from q/k/v. ``interpret=True`` runs the kernel
+    in the Pallas interpreter (CPU tests)."""
+    return _flash(q, k, v, causal, block_q, block_k, interpret)
+
+
+# VMEM ceiling note: each grid program copies the full [t_pad, D] K and V
+# into VMEM (~4*T*D*bytes of the ~16MB/core budget — T up to ~32K at
+# D=64 bf16). Beyond that, shard the sequence instead (ring attention,
+# parallel/ring.py) — the ring's per-shard blocks land back under the
+# ceiling. A k-block grid axis could lift this limit in-kernel; not needed
+# at the lengths the framework targets single-chip.

@@ -1,0 +1,129 @@
+"""Pallas flash-attention kernel (ops/flash_attention.py): interpret-mode
+equivalence against the XLA reference (the dual-path pattern of
+SURVEY.md §4), gradient parity through the custom VJP, and the layer-level
+"auto"/force policy."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.flash_attention import (
+    _reference, flash_attention)
+
+
+def _qkv(rs, B, T, H, D, scale=0.5):
+    return tuple(jnp.asarray(rs.randn(B, T, H, D).astype(np.float32) * s)
+                 for s in (scale, scale, 1.0))
+
+
+class TestKernelEquivalence:
+    @pytest.mark.parametrize("shape", [(2, 16, 2, 8), (1, 64, 4, 16),
+                                       (2, 50, 3, 32), (1, 130, 2, 64)])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_xla_reference(self, shape, causal):
+        rs = np.random.RandomState(0)
+        q, k, v = _qkv(rs, *shape)
+        out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32,
+                              interpret=True)
+        ref = _reference(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=2e-5)
+
+    def test_block_not_dividing_t(self):
+        # T=50 with 32-blocks: padded keys must be excluded exactly
+        rs = np.random.RandomState(1)
+        q, k, v = _qkv(rs, 1, 50, 2, 16)
+        out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32,
+                              interpret=True)
+        ref = _reference(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=2e-5)
+
+    def test_gradients_match_reference(self):
+        rs = np.random.RandomState(2)
+        q, k, v = _qkv(rs, 1, 24, 2, 8)
+
+        gf = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=True, block_q=8, block_k=8, interpret=True) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda q, k, v: jnp.sum(
+            _reference(q, k, v, True) ** 2), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=2e-4)
+
+
+class TestLayerPolicy:
+    def _layer_out(self, use_flash, x, mask=None):
+        from deeplearning4j_tpu.nn.input_type import InputType
+        from deeplearning4j_tpu.nn.layers import MultiHeadAttention
+
+        mha = MultiHeadAttention(n_heads=2, causal=True, use_flash=use_flash)
+        params = mha.init(jax.random.PRNGKey(0), InputType.recurrent(16, 12))
+        y, _ = mha.apply(params, {}, x, mask=mask)
+        return np.asarray(y)
+
+    def test_forced_flash_equals_xla_path(self):
+        rs = np.random.RandomState(3)
+        x = jnp.asarray(rs.randn(2, 12, 16).astype(np.float32))
+        np.testing.assert_allclose(
+            self._layer_out(True, x), self._layer_out(False, x),
+            rtol=1e-5, atol=2e-5)
+
+    def test_auto_on_cpu_uses_xla_path(self):
+        # same numbers (it IS the XLA path on CPU) — and no interpreter cost
+        rs = np.random.RandomState(4)
+        x = jnp.asarray(rs.randn(1, 8, 16).astype(np.float32))
+        np.testing.assert_allclose(
+            self._layer_out("auto", x), self._layer_out(False, x),
+            rtol=0, atol=0)
+
+    def test_masked_attention_falls_back(self):
+        # a key mask routes to the XLA path even when flash is forced
+        rs = np.random.RandomState(5)
+        x = jnp.asarray(rs.randn(2, 12, 16).astype(np.float32))
+        mask = jnp.asarray(np.concatenate(
+            [np.ones((2, 9)), np.zeros((2, 3))], 1).astype(np.float32))
+        np.testing.assert_allclose(
+            self._layer_out(True, x, mask), self._layer_out(False, x, mask),
+            rtol=0, atol=0)
+
+    def test_serde_round_trip_with_flag(self):
+        from deeplearning4j_tpu.nn.config import LayerConfig
+        from deeplearning4j_tpu.nn.layers import MultiHeadAttention
+
+        cfg = MultiHeadAttention(n_heads=4, causal=True, use_flash=False)
+        assert LayerConfig.from_json(cfg.to_json()) == cfg
+
+
+class TestChunkedBackward:
+    def test_chunked_reference_matches_dense(self):
+        from deeplearning4j_tpu.ops.flash_attention import _reference_chunked
+
+        rs = np.random.RandomState(6)
+        q, k, v = _qkv(rs, 2, 50, 2, 16)
+        for causal in (False, True):
+            np.testing.assert_allclose(
+                np.asarray(_reference_chunked(q, k, v, causal, chunk=16)),
+                np.asarray(_reference(q, k, v, causal)),
+                rtol=1e-5, atol=2e-5)
+
+    def test_vjp_grads_match_dense_reference(self):
+        rs = np.random.RandomState(7)
+        q, k, v = _qkv(rs, 1, 40, 2, 8)
+        gf = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=True, block_q=16, block_k=16, interpret=True) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda q, k, v: jnp.sum(
+            _reference(q, k, v, True) ** 2), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=2e-4)
+
+    def test_transformer_block_forwards_flag(self):
+        from deeplearning4j_tpu.nn.layers import TransformerBlock
+
+        blk = TransformerBlock(n_heads=2, use_flash=False)
+        assert blk._mha().use_flash is False
