@@ -182,12 +182,19 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    # rematerialise CHUNKED (never a full [T,T] matrix — the backward must
-    # stay memory-bounded or long-T training dies exactly like the XLA
-    # path the forward kernel replaces)
+    # Rematerialise for the backward. Chunking is a memory/throughput
+    # trade: lax.map serialises chunks (~15% slower at T=2048), so use the
+    # dense [T,T] recompute while the f32 score tensor is affordable and
+    # switch to q-chunks only when it is not (without this, long-T training
+    # dies exactly like the XLA path the forward kernel replaces).
     q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _reference_chunked(q_, k_, v_, causal), q, k, v)
+    B, T, H, _ = q.shape
+    score_bytes = 4 * B * H * T * T
+    if score_bytes <= 2 << 30:
+        fn = lambda q_, k_, v_: _reference(q_, k_, v_, causal)
+    else:
+        fn = lambda q_, k_, v_: _reference_chunked(q_, k_, v_, causal)
+    _, vjp = jax.vjp(fn, q, k, v)
     return vjp(g)
 
 
