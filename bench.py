@@ -73,6 +73,25 @@ def _peak_flops(dtype: str) -> float | None:
     return None  # CPU / unknown: MFU omitted
 
 
+def _mfu_from_cost(compiled, steps_per_sec: float) -> dict:
+    """MFU from XLA's own cost analysis of an AOT-compiled step against the
+    bf16 roofline (jax's default TPU matmul precision multiplies f32 inputs
+    in bf16). Returns {} when unavailable."""
+    peak = _peak_flops("bfloat16")
+    if not peak:
+        return {}
+    try:
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, list) else ca
+        xla_flops = float(ca.get("flops", 0.0))
+    except Exception:
+        return {}  # cost analysis unavailable on some backends
+    if xla_flops <= 0:
+        return {}
+    return {"mfu": round(xla_flops * steps_per_sec / peak, 4),
+            "xla_gflops_per_step": round(xla_flops / 1e9, 2)}
+
+
 def _timed(run, warmup_steps: int = 5, steps: int = 30):
     """run(n) executes n steps and blocks on the result. Returns seconds."""
     if SMOKE:
@@ -287,19 +306,7 @@ def bench_lstm_char_rnn():
         "batch": batch,
         "timesteps": timesteps,
     }
-    # bf16 peak: jax's DEFAULT matmul precision on TPU multiplies f32 inputs
-    # in bf16 (f32 accumulate), so the bf16 roofline is the honest denominator
-    peak = _peak_flops("bfloat16")
-    if peak:
-        try:
-            ca = compiled.cost_analysis()
-            ca = ca[0] if isinstance(ca, list) else ca
-            xla_flops = float(ca.get("flops", 0.0))
-            if xla_flops > 0:
-                out["mfu"] = round(xla_flops * (tps / (batch * timesteps)) / peak, 4)
-                out["xla_gflops_per_step"] = round(xla_flops / 1e9, 2)
-        except Exception:
-            pass  # cost analysis unavailable on some backends
+    out.update(_mfu_from_cost(compiled, tps / (batch * timesteps)))
     return out
 
 
@@ -400,16 +407,7 @@ def bench_transformer():
         "d_model": d_model,
         "note": "beyond-reference flagship (flash-attention path)",
     }
-    peak = _peak_flops("bfloat16")
-    if peak:
-        try:
-            ca = compiled.cost_analysis()
-            ca = ca[0] if isinstance(ca, list) else ca
-            xla_flops = float(ca.get("flops", 0.0))
-            if xla_flops > 0:
-                out["mfu"] = round(xla_flops * (tps / (batch * T)) / peak, 4)
-        except Exception:
-            pass
+    out.update(_mfu_from_cost(compiled, tps / (batch * T)))
     return out
 
 

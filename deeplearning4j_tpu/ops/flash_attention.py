@@ -190,7 +190,9 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
     q, k, v = res
     B, T, H, _ = q.shape
     score_bytes = 4 * B * H * T * T
-    if score_bytes <= 2 << 30:
+    # the dense vjp holds ~3 score-sized f32 tensors at once (softmax
+    # residual p + dp/ds temporaries), so budget for 3x, not 1x
+    if 3 * score_bytes <= 4 << 30:
         fn = lambda q_, k_, v_: _reference(q_, k_, v_, causal)
     else:
         fn = lambda q_, k_, v_: _reference_chunked(q_, k_, v_, causal)

@@ -127,3 +127,22 @@ class TestChunkedBackward:
 
         blk = TransformerBlock(n_heads=2, use_flash=False)
         assert blk._mha().use_flash is False
+
+    def test_chunked_path_gradients(self):
+        # the long-T branch of _flash_bwd differentiates _reference_chunked
+        # through lax.map — cover that vjp machinery directly (the adaptive
+        # threshold keeps small-T tests on the dense branch otherwise)
+        from deeplearning4j_tpu.ops.flash_attention import _reference_chunked
+
+        rs = np.random.RandomState(8)
+        q, k, v = _qkv(rs, 1, 40, 2, 8)
+        for causal in (False, True):
+            gc = jax.grad(lambda q, k, v: jnp.sum(_reference_chunked(
+                q, k, v, causal, chunk=16).astype(jnp.float32) ** 2),
+                argnums=(0, 1, 2))(q, k, v)
+            gd = jax.grad(lambda q, k, v: jnp.sum(
+                _reference(q, k, v, causal).astype(jnp.float32) ** 2),
+                argnums=(0, 1, 2))(q, k, v)
+            for a, b in zip(gc, gd):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-4, atol=2e-4)
