@@ -362,3 +362,19 @@ def save_html(path: str, *components: Component,
     """StaticPageUtil.saveHTMLFile parity."""
     with open(path, "w", encoding="utf-8") as f:
         f.write(render_html(*components, title=title))
+
+
+def reliability_chart(calibration, cls: int = 0) -> ChartLine:
+    """Reliability diagram as a ChartLine (the reference UI's calibration
+    page capability, rendered through this module's DSL): predicted
+    probability vs observed frequency for one class, plus the y=x ideal."""
+    mean_pred, frac_pos = calibration.reliability_diagram(cls)
+    counts = calibration.rel_count[cls]
+    chart = ChartLine(f"Reliability (class {cls})")
+    chart.add_series("ideal", [0.0, 1.0], [0.0, 1.0])
+    # empty bins report (0, 0) — plotting them would zigzag the polyline
+    # back to the origin mid-curve
+    chart.add_series("observed",
+                     [float(p) for p, c in zip(mean_pred, counts) if c > 0],
+                     [float(f) for f, c in zip(frac_pos, counts) if c > 0])
+    return chart

@@ -254,3 +254,38 @@ class TestComponentEdgeCases:
             router.close()
         finally:
             server.stop()
+
+
+class TestReliabilityChart:
+    def test_reliability_chart_from_calibration(self):
+        from deeplearning4j_tpu.eval import EvaluationCalibration
+        from deeplearning4j_tpu.ui.components import reliability_chart
+
+        rs = np.random.RandomState(0)
+        p = rs.rand(300, 2)
+        p /= p.sum(axis=1, keepdims=True)
+        y = np.eye(2)[(rs.rand(300) < p[:, 1]).astype(int)]  # calibrated-ish
+        cal = EvaluationCalibration()
+        cal.eval(y, p)
+        chart = reliability_chart(cal, cls=1)
+        assert chart.seriesNames == ["ideal", "observed"]
+        assert "<polyline" in chart.render()
+        # observed curve must roughly track the diagonal for calibrated data
+        xs, ys = chart.x[1], chart.y[1]
+        if len(xs) >= 3:
+            err = np.mean([abs(a - b) for a, b in zip(xs, ys)])
+            assert err < 0.25, err
+
+    def test_empty_bins_excluded(self):
+        from deeplearning4j_tpu.eval import EvaluationCalibration
+        from deeplearning4j_tpu.ui.components import reliability_chart
+
+        # confident predictions only near 0 and 1: middle bins stay empty
+        p = np.array([[0.97, 0.03], [0.05, 0.95]] * 30)
+        y = np.eye(2)[np.array([1, 0] * 30)]
+        cal = EvaluationCalibration()
+        cal.eval(y, p)
+        chart = reliability_chart(cal, cls=1)
+        xs = chart.x[1]
+        assert len(xs) == 2  # only the two populated bins
+        assert all(x < 0.1 or x > 0.9 for x in xs), xs
