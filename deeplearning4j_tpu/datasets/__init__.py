@@ -39,6 +39,7 @@ from deeplearning4j_tpu.datasets.normalizers import (
     Normalizer,
     NormalizerMinMaxScaler,
     NormalizerStandardize,
+    VGG16ImagePreProcessor,
 )
 from deeplearning4j_tpu.datasets.transform import Schema, TransformProcess
 from deeplearning4j_tpu.datasets.records import (
@@ -61,6 +62,7 @@ __all__ = [
     "SvhnDataSetIterator", "LFWDataSetIterator",
     "UciSequenceDataSetIterator", "uci_synthetic_control", "cache_dir",
     "Normalizer", "NormalizerStandardize", "NormalizerMinMaxScaler",
+    "VGG16ImagePreProcessor",
     "ImagePreProcessingScaler",
     "Schema", "TransformProcess",
     "CSVRecordReader", "CSVSequenceRecordReader", "ImageRecordReader",

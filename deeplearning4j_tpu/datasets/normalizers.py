@@ -47,7 +47,8 @@ class Normalizer:
     @staticmethod
     def from_dict(d: dict) -> "Normalizer":
         cls = {c.TYPE: c for c in (NormalizerStandardize, NormalizerMinMaxScaler,
-                                   ImagePreProcessingScaler)}[d["@type"]]
+                                   ImagePreProcessingScaler,
+                                   VGG16ImagePreProcessor)}[d["@type"]]
         return cls._from_dict(d)
 
     def to_json(self) -> str:
@@ -161,3 +162,39 @@ class ImagePreProcessingScaler(Normalizer):
     @classmethod
     def _from_dict(cls, d):
         return cls(d["min_range"], d["max_range"], d["max_pixel"])
+
+
+class VGG16ImagePreProcessor(Normalizer):
+    """ImageNet per-channel mean subtraction (nd4j
+    VGG16ImagePreProcessor, used by the reference's
+    trainedmodels/TrainedModels.java:86 getPreProcessor): x - mean_rgb,
+    no scaling. Channels-LAST here ([..., h, w, 3] NHWC) — the framework's
+    native image layout."""
+
+    TYPE = "vgg16"
+    MEAN_RGB = (123.68, 116.779, 103.939)
+
+    def fit(self, data):
+        return self  # fixed statistics, nothing to fit
+
+    @staticmethod
+    def _check_nhwc(x):
+        x = np.asarray(x, np.float32)
+        if x.shape[-1] != 3:
+            raise ValueError(
+                f"VGG16ImagePreProcessor expects NHWC RGB input, got "
+                f"trailing dim {x.shape[-1]}")
+        return x
+
+    def transform_features(self, x):
+        return self._check_nhwc(x) - np.asarray(self.MEAN_RGB, np.float32)
+
+    def revert_features(self, x):
+        return self._check_nhwc(x) + np.asarray(self.MEAN_RGB, np.float32)
+
+    def to_dict(self):
+        return {"@type": self.TYPE}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls()

@@ -286,3 +286,30 @@ class TestShardedIterator:
         import pytest
         with pytest.raises(ValueError, match="both"):
             ShardedDataSetIterator([], process_index=1)
+
+
+class TestVGG16Preprocessor:
+    """trainedmodels/TrainedModels.getPreProcessor parity (nd4j
+    VGG16ImagePreProcessor): ImageNet mean-RGB subtraction."""
+
+    def test_subtracts_imagenet_means_and_reverts(self):
+        from deeplearning4j_tpu.datasets import VGG16ImagePreProcessor
+
+        pre = VGG16ImagePreProcessor()
+        x = np.full((2, 4, 4, 3), 150.0, np.float32)
+        t = pre.transform_features(x)
+        np.testing.assert_allclose(
+            t[0, 0, 0], [150.0 - 123.68, 150.0 - 116.779, 150.0 - 103.939],
+            rtol=1e-6)
+        np.testing.assert_allclose(pre.revert_features(t), x, rtol=1e-6)
+
+    def test_serde_and_shape_guard(self):
+        import pytest
+
+        from deeplearning4j_tpu.datasets import Normalizer, VGG16ImagePreProcessor
+
+        pre = VGG16ImagePreProcessor()
+        back = Normalizer.from_json(pre.to_json())
+        assert isinstance(back, VGG16ImagePreProcessor)
+        with pytest.raises(ValueError, match="NHWC"):
+            pre.transform_features(np.zeros((2, 3, 4, 4)))  # NCHW rejected
