@@ -465,32 +465,43 @@ def _infer_input_type(layer_dicts, preprocs: Dict[str, Any],
 
 
 def import_dl4j_zip(path: str, input_type: Optional[InputType] = None):
-    """Load a DL4J MultiLayerNetwork zip -> our MultiLayerNetwork with the
-    parameters (and BN running stats) mapped into native layouts.
-    updaterState.bin is NOT mapped: the reference flattens updater state in
-    updater-block order, and optimizer state is rebuildable; training resumes
-    with fresh accumulators (documented divergence)."""
+    """Load a DL4J MultiLayerNetwork OR ComputationGraph zip -> our model
+    with the parameters (and BN running stats) mapped into native layouts.
+
+    CG weights: the reference splits the flat ``coefficients.bin`` view by
+    walking vertices in the runtime topological order — Kahn's algorithm with
+    a FIFO queue over vertex indices (inputs numbered first in networkInputs
+    order, then config vertices in JSON/insertion order), seeded and expanded
+    in ascending-index order (graph/ComputationGraph.java:377-470, 1211-1300;
+    deterministic because Java HashMap/HashSet over small Integer keys
+    iterate ascending). ``_dl4j_topo_order`` replicates exactly that walk.
+    """
     from deeplearning4j_tpu.nn.model import MultiLayerConfiguration, MultiLayerNetwork
 
     with zipfile.ZipFile(path) as zf:
         conf = json.loads(zf.read("configuration.json").decode("utf-8"))
-        coeff = (zf.read("coefficients.bin")
-                 if "confs" in conf else b"")  # CG path discards weights
+        names = set(zf.namelist())
+        coeff = zf.read("coefficients.bin") if "coefficients.bin" in names else b""
 
     if "vertices" in conf and "confs" not in conf:
-        # ComputationGraph zip: CONFIG import + fresh init. Weight transplant
-        # is deliberately not attempted: the reference flattens CG params in
-        # an order defined by its runtime topological sort
-        # (graph/ComputationGraph.java init), which cannot be replicated
-        # byte-exactly without a JVM to confirm — silent misassignment is
-        # worse than an honest fresh init.
-        model = _import_dl4j_graph_conf(conf, input_type)
-        model.weights_imported = False
+        parsed = _parse_cg_conf(conf)
+        model = _import_dl4j_graph_conf(conf, input_type, parsed=parsed)
+        if coeff:
+            flat = read_nd4j(io.BytesIO(coeff)).ravel().astype(np.float32)
+            _map_cg_weights(model, parsed, flat)
+            model.weights_imported = True
+        else:
+            model.weights_imported = False  # config-only zip: fresh init
         return model
 
     confs = conf.get("confs") or []
     if not confs:
         raise ValueError("configuration.json has no 'confs' — not a MultiLayerNetwork zip")
+    if not coeff:
+        raise ValueError(
+            f"{path!r} has no coefficients.bin — a MultiLayerNetwork zip "
+            "without weights cannot be imported (the reference always writes "
+            "one, ModelSerializer.java:110-150)")
     layer_dicts: List[Tuple[str, dict]] = []
     for c in confs:
         layer = c.get("layer") or {}
@@ -556,10 +567,284 @@ def import_dl4j_zip(path: str, input_type: Optional[InputType] = None):
     return model
 
 
-def _import_dl4j_graph_conf(conf: dict, input_type):
+def _parse_cg_conf(conf: dict):
+    """DL4J CG JSON -> (inputs, outputs, vertex_inputs, vertices) where
+    ``vertices`` is an ordered dict name -> (vertex_type, body) preserving the
+    JSON/insertion order that defines the reference's vertex numbering."""
+    inputs = list(conf.get("networkInputs") or [])
+    outputs = list(conf.get("networkOutputs") or [])
+    vertex_inputs: Dict[str, List[str]] = {
+        k: list(v) for k, v in (conf.get("vertexInputs") or {}).items()}
+    raw = conf.get("vertices") or {}
+    if not inputs or not outputs:
+        raise ValueError("CG config lacks networkInputs/networkOutputs")
+    vertices: Dict[str, Tuple[str, dict]] = {}
+    for name, vd in raw.items():
+        if not isinstance(vd, dict) or len(vd) != 1:
+            raise ValueError(f"unparseable vertex {name!r}: {vd!r}")
+        vtype = next(iter(vd))
+        vertices[name] = (vtype, vd[vtype] or {})
+    return inputs, outputs, vertex_inputs, vertices
+
+
+def _dl4j_topo_order(inputs: List[str], vertex_names: List[str],
+                     vertex_inputs: Dict[str, List[str]]) -> List[str]:
+    """The reference's exact topological walk (ComputationGraph.java:1211-1300):
+    vertex indices = networkInputs order then config-vertex insertion order;
+    Kahn's algorithm with a FIFO queue, seeded with the zero-in-degree
+    vertices in ascending index order, and each popped vertex's outputs
+    relaxed in ascending index order."""
+    from collections import deque
+
+    names = list(inputs) + list(vertex_names)
+    idx = {n: i for i, n in enumerate(names)}
+    in_edges: Dict[int, set] = {i: set() for i in range(len(names))}
+    out_edges: Dict[int, set] = {i: set() for i in range(len(names))}
+    for n in vertex_names:
+        for s in vertex_inputs.get(n, []):
+            if s not in idx:
+                raise ValueError(f"vertex {n!r} has unknown input {s!r}")
+            in_edges[idx[n]].add(idx[s])
+            out_edges[idx[s]].add(idx[n])
+    queue = deque(i for i in range(len(names)) if not in_edges[i])
+    order: List[int] = []
+    while queue:
+        nxt = queue.popleft()
+        order.append(nxt)
+        for v in sorted(out_edges[nxt]):
+            in_edges[v].discard(nxt)
+            if not in_edges[v]:
+                queue.append(v)
+    if len(order) != len(names):
+        left = [names[i] for i, s in in_edges.items() if s]
+        raise ValueError(f"cycle detected in CG config involving {left}")
+    return [names[i] for i in order]
+
+
+def _vertex_preproc(body: dict) -> Optional[Tuple[str, dict]]:
+    """LayerVertex 'preProcessor' (InputPreProcessor.java:39-50
+    WRAPPER_OBJECT names) -> (name, fields) or None."""
+    pp = body.get("preProcessor")
+    if isinstance(pp, dict) and len(pp) == 1:
+        n = next(iter(pp))
+        return n, (pp[n] or {})
+    return None
+
+
+def _pp_hwc(fields: dict) -> Optional[Tuple[int, int, int]]:
+    h = fields.get("inputHeight") or fields.get("numRows")
+    w = fields.get("inputWidth") or fields.get("numColumns")
+    c = fields.get("numChannels")
+    if h and w and c:
+        return int(h), int(w), int(c)
+    return None
+
+
+_FF_LAYER_TYPES = ("dense", "output", "embedding", "loss", "activation", "dropout")
+_RNN_LAYER_TYPES = ("gravesLSTM", "LSTM", "SimpleRnn", "rnnoutput")
+_CNN_LAYER_TYPES = ("convolution", "subsampling", "batchNormalization",
+                    "localResponseNormalization")
+
+
+def _layer_of(body: dict) -> Tuple[str, dict]:
+    layer_wrap = (body.get("layerConf") or {}).get("layer") or {}
+    if len(layer_wrap) != 1:
+        raise ValueError(f"unparseable LayerVertex layerConf {body!r}")
+    t = next(iter(layer_wrap))
+    return t, layer_wrap[t]
+
+
+def _infer_cg_input_types(parsed, build_fn) -> List[InputType]:
+    """Reconstruct the per-input InputTypes a DL4J CG conf does NOT serialize
+    (ComputationGraphConfiguration keeps networkInputTypes builder-side only,
+    ComputationGraphConfiguration.java:556,921 — but GraphBuilder.setInputTypes
+    leaves two recoverable traces: nIn on every layer and InputPreProcessors
+    embedded in LayerVertex JSON).
+
+    Strategy per input: (a) a direct consumer's preProcessor names the type
+    outright (cnnToFeedForward => conv(h,w,c); feedForwardToCnn =>
+    ff of h*w*c); (b) a direct ff/rnn layer consumer's nIn; (c) conv-family
+    consumer: channels = conv nIn, then scan square sizes s=1..512, building
+    the (uninitialized) graph per candidate and accepting the first s whose
+    resolved flatten points agree with every stored cnnToFeedForward dim /
+    dense-after-conv nIn in the conf. Ambiguity or no constraint => raise,
+    asking for an explicit input_type."""
+    inputs, outputs, vertex_inputs, vertices = parsed
+
+    consumers: Dict[str, List[str]] = {i: [] for i in inputs}
+    for name in vertices:
+        for s in vertex_inputs.get(name, []):
+            if s in consumers:
+                consumers[s].append(name)
+
+    resolved: List[Optional[InputType]] = []
+    unresolved_conv: List[Tuple[int, int]] = []  # (input index, channels)
+    for ii, inp in enumerate(inputs):
+        it: Optional[InputType] = None
+        conv_channels = None
+        for cname in consumers[inp]:
+            vtype, body = vertices[cname]
+            if vtype != "LayerVertex":
+                continue
+            pp = _vertex_preproc(body)
+            t, d = _layer_of(body)
+            n_in = int(d.get("nin") or d.get("nIn") or 0)
+            if pp is not None:
+                hwc = _pp_hwc(pp[1])
+                if pp[0] == "cnnToFeedForward" and hwc:
+                    it = InputType.convolutional(*hwc)
+                    break
+                if pp[0] == "feedForwardToCnn" and hwc:
+                    it = InputType.convolutional_flat(*hwc)
+                    break
+                if pp[0] == "rnnToFeedForward" and n_in:
+                    it = InputType.recurrent(n_in)
+                    break
+                if pp[0] == "feedForwardToRnn" and n_in:
+                    it = InputType.feed_forward(n_in)
+                    break
+            if t in _RNN_LAYER_TYPES and n_in:
+                it = InputType.recurrent(n_in)
+                break
+            if t in _FF_LAYER_TYPES and n_in:
+                it = InputType.feed_forward(n_in)
+                break
+            if t == "convolution" and n_in:
+                conv_channels = n_in
+        if it is None and conv_channels is not None:
+            unresolved_conv.append((ii, conv_channels))
+        resolved.append(it)
+
+    missing = [inputs[i] for i, it in enumerate(resolved)
+               if it is None and i not in [u[0] for u in unresolved_conv]]
+    if missing:
+        raise ValueError(
+            f"cannot infer InputType for CG inputs {missing} — pass "
+            "input_type= (one InputType per network input)")
+
+    if not unresolved_conv:
+        return resolved  # type: ignore[return-value]
+
+    def _flatten_constraints_ok(model) -> int:
+        """#constraints checked, or -1 on any mismatch."""
+        checks = 0
+        for name, (vtype, body) in vertices.items():
+            if vtype != "LayerVertex":
+                continue
+            rt = model.rt.get(name)
+            if rt is None:
+                return -1
+            src_t = model.vertex_types.get(rt.inputs[0])
+            pp = _vertex_preproc(body)
+            if pp is not None and pp[0] == "cnnToFeedForward":
+                hwc = _pp_hwc(pp[1])
+                if hwc:
+                    checks += 1
+                    if (src_t is None or src_t.kind != "conv" or
+                            (src_t.height, src_t.width, src_t.channels) != hwc):
+                        return -1
+                    continue
+            if rt.pre is not None and src_t is not None and src_t.kind == "conv":
+                t, d = _layer_of(body)
+                n_in = int(d.get("nin") or d.get("nIn") or 0)
+                if n_in:
+                    checks += 1
+                    if src_t.flat_size() != n_in:
+                        return -1
+        return checks
+
+    matches: List[List[InputType]] = []
+    match_sizes: List[int] = []
+    first_build_error: Optional[Exception] = None
+    any_built = False
+    for s in range(1, 513):
+        cand = list(resolved)
+        for ii, ch in unresolved_conv:
+            cand[ii] = InputType.convolutional(s, s, ch)
+        try:
+            model = build_fn(cand, init=False)
+        except Exception as e:  # most candidates legitimately fail shape checks
+            if first_build_error is None:
+                first_build_error = e
+            continue
+        any_built = True
+        checks = _flatten_constraints_ok(model)
+        if checks > 0:
+            matches.append(cand)
+            match_sizes.append(s)
+    if len(matches) == 1:
+        return matches[0]  # type: ignore[return-value]
+    names = [inputs[i] for i, _ in unresolved_conv]
+    if len(matches) > 1:
+        raise ValueError(
+            f"ambiguous conv input size for CG inputs {names}: sizes "
+            f"{match_sizes} all satisfy the conf's flatten constraints — "
+            "pass input_type= (one InputType per network input)")
+    if not any_built and first_build_error is not None:
+        # every candidate failed identically: a size-INDEPENDENT config
+        # problem — surface it instead of blaming the missing input size
+        raise first_build_error
+    raise ValueError(
+        f"cannot infer the conv input height/width for CG inputs {names}: "
+        "no stored InputPreProcessor or dense-nIn flatten constraint pins "
+        "the size — pass input_type= (one InputType per network input)")
+
+
+def _map_cg_weights(model, parsed, flat: np.ndarray):
+    """Split coefficients.bin by the reference's topological walk and map
+    each LayerVertex segment into our per-vertex param/state dicts."""
+    import jax.numpy as jnp
+
+    inputs, outputs, vertex_inputs, vertices = parsed
+    order = _dl4j_topo_order(inputs, list(vertices), vertex_inputs)
+    input_set = set(inputs)
+    pos = 0
+    for name in order:
+        if name in input_set:
+            continue
+        vtype, body = vertices[name]
+        if vtype != "LayerVertex":
+            continue  # all supported non-layer vertices are parameter-free
+        t, d = _layer_of(body)
+        rt = model.rt[name]
+        in_t = rt.input_types[0]
+        src_t = model.vertex_types.get(rt.inputs[0])
+        # dense-after-conv needs the CONV shape for the (c,h,w)->(h,w,c)
+        # flatten permutation, which our auto-preprocessor hides
+        if rt.pre is not None and src_t is not None and src_t.kind == "conv":
+            in_t = src_t
+        p, st, pos = _map_layer_params(rt.config, d, flat, pos, in_t)
+        if p:
+            model.params[name] = {k: jnp.asarray(v) for k, v in p.items()}
+        if st:
+            model.state[name] = {k: jnp.asarray(v) for k, v in st.items()}
+    if pos != flat.size:
+        raise ValueError(
+            f"coefficients.bin has {flat.size} values but the CG configuration "
+            f"consumes {pos} — vertex/param layout mismatch")
+    model.opt_state = {
+        name: u.init(model.params[name]) for name, u in model._updaters.items()}
+
+
+def _import_dl4j_graph_conf(conf: dict, input_type, parsed=None):
     """DL4J ComputationGraphConfiguration JSON -> our ComputationGraph
     (freshly initialized). Vertex dialect: conf/graph/GraphVertex.java:40-52
     WRAPPER_OBJECT names; layer vertices wrap a NeuralNetConfiguration."""
+    if parsed is None:
+        parsed = _parse_cg_conf(conf)
+    inputs, outputs, vertex_inputs, vertices = parsed
+
+    def build(its, init=True):
+        return _build_cg(inputs, outputs, vertex_inputs, vertices, its, init)
+
+    if input_type is None:
+        its = _infer_cg_input_types(parsed, build)
+    else:
+        its = list(input_type) if isinstance(input_type, (list, tuple)) else [input_type]
+    return build(its, init=True)
+
+
+def _build_cg(inputs, outputs, vertex_inputs, vertices, its, init=True):
     from deeplearning4j_tpu.nn.graph import (
         ComputationGraph,
         ComputationGraphConfiguration,
@@ -568,20 +853,7 @@ def _import_dl4j_graph_conf(conf: dict, input_type):
         SubsetVertex,
     )
 
-    inputs = list(conf.get("networkInputs") or [])
-    outputs = list(conf.get("networkOutputs") or [])
-    vertex_inputs: Dict[str, List[str]] = {
-        k: list(v) for k, v in (conf.get("vertexInputs") or {}).items()}
-    vertices = conf.get("vertices") or {}
-    if not inputs or not outputs:
-        raise ValueError("CG config lacks networkInputs/networkOutputs")
-
     g = ComputationGraphConfiguration.builder().add_inputs(*inputs)
-    if input_type is None:
-        raise ValueError(
-            "DL4J ComputationGraph configs do not carry input dimensions — "
-            "pass input_type= (one InputType per network input)")
-    its = input_type if isinstance(input_type, (list, tuple)) else [input_type]
     g.set_input_types(*its)
 
     from deeplearning4j_tpu.nn.graph import (
@@ -627,19 +899,14 @@ def _import_dl4j_graph_conf(conf: dict, input_type):
             ins = vertex_inputs.get(name, [])
             if any(i not in added for i in ins):
                 continue
-            vd = vertices.get(name)
-            if not isinstance(vd, dict) or len(vd) != 1:
-                raise ValueError(f"unparseable vertex {name!r}: {vd!r}")
-            vtype = next(iter(vd))
-            body = vd[vtype] or {}
+            if name not in vertices:
+                raise ValueError(f"vertexInputs names unknown vertex {name!r}")
+            vtype, body = vertices[name]
             if vtype == "LayerVertex":
-                layer_wrap = (body.get("layerConf") or {}).get("layer") or {}
-                if len(layer_wrap) != 1:
-                    raise ValueError(f"unparseable LayerVertex {name!r}")
-                t = next(iter(layer_wrap))
-                g.add_layer(name, dl4j_layer_to_config(t, layer_wrap[t]), *ins)
+                t, d = _layer_of(body)
+                g.add_layer(name, dl4j_layer_to_config(t, d), *ins)
                 if updater is None:
-                    updater = _parse_updater(layer_wrap[t])
+                    updater = _parse_updater(d)
             else:
                 g.add_vertex(name, make_vertex(vtype, body), *ins)
             added.add(name)
@@ -649,7 +916,8 @@ def _import_dl4j_graph_conf(conf: dict, input_type):
             raise ValueError(f"cyclic or dangling vertex inputs: {pending}")
     g.set_outputs(*outputs)
     g.updater(updater or {"type": "sgd", "lr": 0.1})
-    return ComputationGraph(g.build()).init()
+    model = ComputationGraph(g.build())
+    return model.init() if init else model
 
 
 # ---------------------------------------------------------------------------

@@ -241,7 +241,10 @@ class TransferLearningGraphBuilder:
         vertices: Dict[str, VertexSpec] = {}
         for name, spec in self._vertices.items():
             cfg = spec.config
-            if name in self._frozen:
+            if name in self._frozen and any(
+                    f.name == "trainable" for f in dataclasses.fields(cfg)):
+                # param-free vertices (merge/elementwise/...) carry trainable
+                # only as a class attribute and have nothing to freeze
                 cfg = dataclasses.replace(cfg, trainable=False)
             if self._ftc is not None and hasattr(cfg, "dropout"):
                 cfg = self._ftc.apply_to_layer(cfg)

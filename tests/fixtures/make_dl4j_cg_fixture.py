@@ -1,0 +1,113 @@
+"""Generate the FROZEN DL4J ComputationGraph fixture (dl4j_cg_tiny.zip +
+dl4j_cg_tiny_golden.npz).
+
+Run once, commit the outputs, then NEVER regenerate (the committed bytes are
+the serialization-stability contract, RegressionTest080 pattern). The zip is
+hand-built in the reference's formats from first principles:
+
+- coefficients.bin segments follow the reference's runtime topological walk
+  (graph/ComputationGraph.java:377-470), NOT the JSON vertex order — the
+  JSON order here is deliberately scrambled so a JSON-order importer fails.
+- Golden outputs come from an independent NumPy NCHW forward pass (truncate
+  conv, channel-concat MergeVertex, (c,h,w) flatten), mirroring
+  tests/test_dl4j_import.py's independence methodology.
+"""
+import io
+import json
+import os
+import sys
+import zipfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+from deeplearning4j_tpu.modelimport.dl4j import write_nd4j  # noqa: E402
+
+FIXDIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _relu(x):
+    return np.maximum(x, 0.0)
+
+
+def _softmax(x):
+    e = np.exp(x - x.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+def _conv_nchw(x, W, b):
+    B, C, H, Wd = x.shape
+    O, _, kh, kw = W.shape
+    oh, ow = H - kh + 1, Wd - kw + 1
+    out = np.zeros((B, O, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = x[:, :, i:i + kh, j:j + kw]
+            out[:, :, i, j] = np.tensordot(patch, W, axes=([1, 2, 3], [1, 2, 3]))
+    return out + b[None, :, None, None]
+
+
+def main():
+    rs = np.random.RandomState(2026)
+    c1W = (rs.randn(4, 1, 3, 3) * 0.5).astype(np.float32)
+    c1B = (rs.randn(4) * 0.1).astype(np.float32)
+    b1W = (rs.randn(4, 4, 1, 1) * 0.5).astype(np.float32)
+    b1B = (rs.randn(4) * 0.1).astype(np.float32)
+    outW = (rs.randn(128, 3) * 0.3).astype(np.float32)
+    outB = (rs.randn(3) * 0.1).astype(np.float32)
+
+    # reference flat order = topological walk: c1, b1, out
+    flat = np.concatenate([
+        c1B, c1W.ravel(),
+        b1B, b1W.ravel(),
+        outW.ravel(order="F"), outB,
+    ]).astype(np.float32)
+
+    conf = {
+        "networkInputs": ["in"],
+        "networkOutputs": ["out"],
+        "vertexInputs": {
+            "c1": ["in"], "b1": ["c1"], "add": ["b1", "c1"],
+            "merge": ["c1", "add"], "out": ["merge"],
+        },
+        "vertices": {  # scrambled vs topo order on purpose
+            "b1": {"LayerVertex": {"layerConf": {"layer": {"convolution": {
+                "nin": 4, "nout": 4, "kernelSize": [1, 1], "stride": [1, 1],
+                "padding": [0, 0], "convolutionMode": "Truncate",
+                "hasBias": True, "activationFn": {"Identity": {}}}}}}},
+            "out": {"LayerVertex": {
+                "layerConf": {"layer": {"output": {
+                    "nin": 128, "nout": 3, "activationFn": {"Softmax": {}},
+                    "lossFn": {"@class":
+                               "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"}}}},
+                "preProcessor": {"cnnToFeedForward": {
+                    "inputHeight": 4, "inputWidth": 4, "numChannels": 8}}}},
+            "c1": {"LayerVertex": {"layerConf": {"layer": {"convolution": {
+                "nin": 1, "nout": 4, "kernelSize": [3, 3], "stride": [1, 1],
+                "padding": [0, 0], "convolutionMode": "Truncate",
+                "hasBias": True, "activationFn": {"ReLU": {}},
+                "iUpdater": {"Adam": {"learningRate": 0.001}}}}}}},
+            "add": {"ElementWiseVertex": {"op": "Add"}},
+            "merge": {"MergeVertex": {}},
+        },
+    }
+    buf = io.BytesIO()
+    write_nd4j(buf, flat[None, :], "FLOAT")
+    zpath = os.path.join(FIXDIR, "dl4j_cg_tiny.zip")
+    with zipfile.ZipFile(zpath, "w") as zf:
+        zf.writestr("configuration.json", json.dumps(conf))
+        zf.writestr("coefficients.bin", buf.getvalue())
+
+    x = rs.rand(3, 1, 6, 6).astype(np.float32)
+    c1 = _relu(_conv_nchw(x, c1W, c1B))
+    b1 = _conv_nchw(c1, b1W, b1B)
+    merged = np.concatenate([c1, b1 + c1], axis=1)
+    probs = _softmax(merged.reshape(3, -1) @ outW + outB)
+    x_nhwc = np.transpose(x, (0, 2, 3, 1))
+    np.savez(os.path.join(FIXDIR, "dl4j_cg_tiny_golden.npz"),
+             x=x_nhwc, y=probs)
+    print("wrote", zpath)
+
+
+if __name__ == "__main__":
+    main()

@@ -356,62 +356,202 @@ class TestCommittedFixture:
         got = np.asarray(model.output(g["x"]))
         np.testing.assert_allclose(got, g["y"], rtol=1e-5, atol=1e-6)
 
+    def test_cg_fixture_imports_and_matches_golden(self):
+        zpath = os.path.join(FIXDIR, "dl4j_cg_tiny.zip")
+        gpath = os.path.join(FIXDIR, "dl4j_cg_tiny_golden.npz")
+        assert os.path.exists(zpath), "committed CG fixture missing"
+        model = import_dl4j_zip(zpath)  # input type inferred from the conf
+        assert model.weights_imported is True
+        g = np.load(gpath)
+        got = np.asarray(model.output(g["x"]))
+        np.testing.assert_allclose(got, g["y"], rtol=1e-5, atol=1e-6)
 
-class TestGraphConfigImport:
-    """DL4J ComputationGraph zips: CONFIG import + fresh init (weight
-    transplant deliberately not attempted — flat CG param order is defined
-    by the reference runtime's toposort; see import_dl4j_zip docstring)."""
+    def test_cg_fixture_via_guesser_and_pretrained(self):
+        """load_any consumes a reference-format CG zip without manual
+        input_type, and init_pretrained transplants its weights into a
+        matching fresh config (ZooModel.initPretrained flow)."""
+        from deeplearning4j_tpu.models.pretrained import init_pretrained
+        from deeplearning4j_tpu.utils.guesser import load_any
 
-    def _cg_zip(self, path):
-        conf = {
-            "networkInputs": ["in"],
-            "networkOutputs": ["out"],
-            "vertexInputs": {
-                "c1": ["in"], "branch": ["c1"], "add": ["branch", "c1"],
-                "out": ["add"],
-            },
-            "vertices": {
-                "c1": {"LayerVertex": {"layerConf": {"layer": {"convolution": {
-                    "nin": 1, "nout": 4, "kernelSize": [3, 3],
-                    "stride": [1, 1], "padding": [0, 0],
-                    "convolutionMode": "Same", "activationFn": {"ReLU": {}},
-                    "iUpdater": {"Adam": {"learningRate": 0.001}}}}}}},
-                "branch": {"LayerVertex": {"layerConf": {"layer": {"convolution": {
-                    "nin": 4, "nout": 4, "kernelSize": [1, 1],
-                    "stride": [1, 1], "padding": [0, 0],
-                    "convolutionMode": "Same",
-                    "activationFn": {"Identity": {}}}}}}},
-                "add": {"ElementWiseVertex": {"op": "Add"}},
-                "out": {"LayerVertex": {"layerConf": {"layer": {"output": {
-                    "nin": 144, "nout": 3, "activationFn": {"Softmax": {}},
+        zpath = os.path.join(FIXDIR, "dl4j_cg_tiny.zip")
+        g = np.load(os.path.join(FIXDIR, "dl4j_cg_tiny_golden.npz"))
+        model = load_any(zpath)
+        got = np.asarray(model.output(g["x"]))
+        np.testing.assert_allclose(got, g["y"], rtol=1e-5, atol=1e-6)
+
+        fresh = init_pretrained(model.conf, weights=zpath)
+        assert set(fresh.pretrained_summary["loaded"]) >= {"c1", "b1", "out"}
+        got2 = np.asarray(fresh.output(g["x"]))
+        np.testing.assert_allclose(got2, g["y"], rtol=1e-5, atol=1e-6)
+
+
+def _build_cg_zip(path):
+    """Hand-built DL4J ComputationGraph zip: conv(3x3,4,relu) -> 1x1-conv
+    residual add -> channel merge -> softmax output. Input 1x6x6.
+
+    Weights are laid out in the REFERENCE's flat order: the runtime
+    topological walk (ComputationGraph.java:377-470) — NOT the JSON vertex
+    order, which is deliberately scrambled here (b1, out, c1, add, merge) so
+    an importer that splits coefficients.bin by JSON order mis-assigns every
+    segment. Expected outputs come from an independent NumPy NCHW forward.
+    Returns (x_nchw, expected_probs)."""
+    rs = np.random.RandomState(77)
+    c1W = (rs.randn(4, 1, 3, 3) * 0.5).astype(np.float32)   # (O,C,kh,kw)
+    c1B = (rs.randn(4) * 0.1).astype(np.float32)
+    b1W = (rs.randn(4, 4, 1, 1) * 0.5).astype(np.float32)
+    b1B = (rs.randn(4) * 0.1).astype(np.float32)
+    outW = (rs.randn(128, 3) * 0.3).astype(np.float32)      # (nIn,nOut)
+    outB = (rs.randn(3) * 0.1).astype(np.float32)
+
+    # reference flat order: topo walk = in, c1, b1, add, merge, out
+    flat = np.concatenate([
+        c1B, c1W.ravel(),                    # conv: [b | W C-order]
+        b1B, b1W.ravel(),
+        outW.ravel(order="F"), outB,         # dense: [W F-order | b]
+    ]).astype(np.float32)
+
+    conf = {
+        "networkInputs": ["in"],
+        "networkOutputs": ["out"],
+        "vertexInputs": {
+            "c1": ["in"], "b1": ["c1"], "add": ["b1", "c1"],
+            "merge": ["c1", "add"], "out": ["merge"],
+        },
+        # scrambled on purpose — vertex numbering follows THIS order, the
+        # flat param order follows the topological walk over those numbers
+        "vertices": {
+            "b1": {"LayerVertex": {"layerConf": {"layer": {"convolution": {
+                "nin": 4, "nout": 4, "kernelSize": [1, 1], "stride": [1, 1],
+                "padding": [0, 0], "convolutionMode": "Truncate",
+                "hasBias": True, "activationFn": {"Identity": {}}}}}}},
+            "out": {"LayerVertex": {
+                "layerConf": {"layer": {"output": {
+                    "nin": 128, "nout": 3, "activationFn": {"Softmax": {}},
                     "lossFn": {"@class":
-                               "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"}}}}}},
-            },
-        }
-        buf = io.BytesIO()
-        write_nd4j(buf, np.zeros((1, 1), np.float32), "FLOAT")
-        with zipfile.ZipFile(path, "w") as zf:
-            zf.writestr("configuration.json", json.dumps(conf))
-            zf.writestr("coefficients.bin", buf.getvalue())
+                               "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"}}}},
+                "preProcessor": {"cnnToFeedForward": {
+                    "inputHeight": 4, "inputWidth": 4, "numChannels": 8}}}},
+            "c1": {"LayerVertex": {"layerConf": {"layer": {"convolution": {
+                "nin": 1, "nout": 4, "kernelSize": [3, 3], "stride": [1, 1],
+                "padding": [0, 0], "convolutionMode": "Truncate",
+                "hasBias": True, "activationFn": {"ReLU": {}},
+                "iUpdater": {"Adam": {"learningRate": 0.001}}}}}}},
+            "add": {"ElementWiseVertex": {"op": "Add"}},
+            "merge": {"MergeVertex": {}},
+        },
+    }
+    buf = io.BytesIO()
+    write_nd4j(buf, flat[None, :], "FLOAT")
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("configuration.json", json.dumps(conf))
+        zf.writestr("coefficients.bin", buf.getvalue())
 
-    def test_cg_config_imports_and_runs(self, tmp_path):
+    # independent NumPy NCHW forward
+    x = rs.rand(3, 1, 6, 6).astype(np.float32)
+    c1 = _act_relu(_np_conv_nchw(x, c1W, c1B))              # (3,4,4,4)
+    b1 = _np_conv_nchw(c1, b1W, b1B)
+    added = b1 + c1
+    merged = np.concatenate([c1, added], axis=1)            # (3,8,4,4)
+    h = merged.reshape(3, -1)                               # (c,h,w) flatten
+    probs = _softmax(h @ outW + outB)
+    return x, probs
+
+
+class TestGraphWeightImport:
+    """DL4J ComputationGraph zips: full weight import via the reference's
+    topological param-flattening walk, with inferred input types."""
+
+    def test_cg_weights_match_independent_numpy(self, tmp_path):
         p = str(tmp_path / "cg.zip")
-        self._cg_zip(p)
-        model = import_dl4j_zip(p, input_type=InputType.convolutional(6, 6, 1))
-        assert model.weights_imported is False
+        x_nchw, expected = _build_cg_zip(p)
+        model = import_dl4j_zip(p)  # input type inferred from the conf
+        assert model.weights_imported is True
         from deeplearning4j_tpu.nn.graph import ComputationGraph
         assert isinstance(model, ComputationGraph)
+        x_nhwc = np.transpose(x_nchw, (0, 2, 3, 1))
+        got = np.asarray(model.output(x_nhwc))
+        np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+    def test_cg_explicit_input_type_matches_too(self, tmp_path):
+        p = str(tmp_path / "cg.zip")
+        x_nchw, expected = _build_cg_zip(p)
+        model = import_dl4j_zip(p, input_type=InputType.convolutional(6, 6, 1))
+        got = np.asarray(model.output(np.transpose(x_nchw, (0, 2, 3, 1))))
+        np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+    def test_cg_updater_imported_and_trains(self, tmp_path):
+        p = str(tmp_path / "cg.zip")
+        x_nchw, _ = _build_cg_zip(p)
+        model = import_dl4j_zip(p)
+        from deeplearning4j_tpu.train.updaters import normalize_updater
+        assert normalize_updater(model.conf.updater)["type"] == "adam"
         rs = np.random.RandomState(0)
-        out = np.asarray(model.output(rs.rand(2, 6, 6, 1).astype(np.float32)))
-        assert out.shape == (2, 3)
-        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
-        # and it trains
-        y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 2)]
-        l = model.fit_batch((rs.rand(2, 6, 6, 1).astype(np.float32), y))
+        y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 3)]
+        l = model.fit_batch((np.transpose(x_nchw, (0, 2, 3, 1)), y))
         assert np.isfinite(float(l))
 
-    def test_cg_requires_input_type(self, tmp_path):
+    def test_cg_transfer_surgery_on_imported(self, tmp_path):
         p = str(tmp_path / "cg.zip")
-        self._cg_zip(p)
+        x_nchw, _ = _build_cg_zip(p)
+        model = import_dl4j_zip(p)
+        from deeplearning4j_tpu.nn.transfer import TransferLearning
+        new = (TransferLearning.graph_builder(model)
+               .set_feature_extractor("merge")
+               .n_out_replace("out", 7)
+               .build())
+        out = np.asarray(new.output(np.transpose(x_nchw, (0, 2, 3, 1))))
+        assert out.shape == (3, 7)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-4)
+
+    def test_cg_wrong_length_rejected(self, tmp_path):
+        p = str(tmp_path / "cg.zip")
+        _build_cg_zip(p)
+        with zipfile.ZipFile(p) as zf:
+            conf = zf.read("configuration.json")
+            coeff = zf.read("coefficients.bin")
+        flat = read_nd4j(io.BytesIO(coeff)).ravel()
+        buf = io.BytesIO()
+        write_nd4j(buf, flat[None, :-5], "FLOAT")
+        p2 = str(tmp_path / "bad.zip")
+        with zipfile.ZipFile(p2, "w") as zf:
+            zf.writestr("configuration.json", conf)
+            zf.writestr("coefficients.bin", buf.getvalue())
+        with pytest.raises(ValueError, match="exhaust|mismatch"):
+            import_dl4j_zip(p2)
+
+    def test_cg_config_only_zip_fresh_inits(self, tmp_path):
+        p = str(tmp_path / "cg.zip")
+        _build_cg_zip(p)
+        with zipfile.ZipFile(p) as zf:
+            conf = zf.read("configuration.json")
+        p2 = str(tmp_path / "conf_only.zip")
+        with zipfile.ZipFile(p2, "w") as zf:
+            zf.writestr("configuration.json", conf)
+        model = import_dl4j_zip(p2)
+        assert model.weights_imported is False
+        out = np.asarray(model.output(np.zeros((1, 6, 6, 1), np.float32)))
+        assert out.shape == (1, 3)
+
+    def test_cg_uninferrable_requires_input_type(self, tmp_path):
+        """A conv-input CG with no stored preprocessor and no
+        dense-after-conv nIn cannot pin h/w — must ask for input_type."""
+        conf = {
+            "networkInputs": ["in"], "networkOutputs": ["out"],
+            "vertexInputs": {"c1": ["in"], "out": ["c1"]},
+            "vertices": {
+                "c1": {"LayerVertex": {"layerConf": {"layer": {"convolution": {
+                    "nin": 1, "nout": 2, "kernelSize": [3, 3],
+                    "stride": [1, 1], "padding": [0, 0],
+                    "convolutionMode": "Truncate",
+                    "activationFn": {"ReLU": {}}}}}}},
+                "out": {"LayerVertex": {"layerConf": {"layer": {"loss": {
+                    "activationFn": {"Identity": {}},
+                    "lossFn": {"@class":
+                               "org.nd4j.linalg.lossfunctions.impl.LossMSE"}}}}}},
+            },
+        }
+        p = str(tmp_path / "cg.zip")
+        with zipfile.ZipFile(p, "w") as zf:
+            zf.writestr("configuration.json", json.dumps(conf))
         with pytest.raises(ValueError, match="input_type"):
             import_dl4j_zip(p)
