@@ -278,6 +278,12 @@ def dl4j_layer_to_config(type_name: str, d: Dict[str, Any]):
     act = _parse_activation(d)
     wi = _parse_weight_init(d)
     kw = _common_kwargs(d)
+    # per-layer iUpdater override is first-class in DL4J; carry it onto the
+    # layer config so model._build_updaters honors it (and updater-state
+    # accumulators land in matching opt_state structures)
+    lupd = _parse_updater(d)
+    if lupd is not None:
+        kw["updater"] = lupd
     n_in = int(d.get("nin") or d.get("nIn") or 0) or None
     n_out = int(d.get("nout") or d.get("nOut") or 0) or None
     t = type_name
@@ -310,7 +316,8 @@ def dl4j_layer_to_config(type_name: str, d: Dict[str, Any]):
     if t == "batchNormalization":
         return L.BatchNorm(decay=float(d.get("decay", 0.9)),
                            eps=float(d.get("eps", 1e-5)),
-                           use_gamma_beta=not bool(d.get("lockGammaBeta", False)))
+                           use_gamma_beta=not bool(d.get("lockGammaBeta", False)),
+                           updater=kw.get("updater"))
     if t == "localResponseNormalization":
         return L.LocalResponseNormalization(
             k=float(d.get("k", 2.0)), n=int(d.get("n", 5)),
@@ -326,7 +333,8 @@ def dl4j_layer_to_config(type_name: str, d: Dict[str, Any]):
         return L.SimpleRnn(n_in=n_in, n_out=n_out, activation=act, weight_init=wi, **kw)
     if t == "embedding":
         return L.Embedding(n_in=n_in, n_out=n_out, weight_init=wi,
-                           has_bias=bool(d.get("hasBias", True)))
+                           has_bias=bool(d.get("hasBias", True)),
+                           updater=kw.get("updater"))
     if t == "activation":
         return L.ActivationLayer(activation=act)
     if t == "dropout":
@@ -438,6 +446,188 @@ def _map_layer_params(cfg, d: Dict[str, Any], flat: np.ndarray, pos: int,
 
 
 # ---------------------------------------------------------------------------
+# Updater state (updaterState.bin)
+# ---------------------------------------------------------------------------
+# The reference flattens optimizer state per UPDATER BLOCK: contiguous
+# (layer, variable) pairs with equal updater configs merge into one block
+# (BaseMultiLayerUpdater.java:56-127, UpdaterUtils.updaterConfigurationsEquals),
+# and each block's view is [acc1(all vars) | acc2(all vars) | ...] — the
+# ND4J GradientUpdater.setStateViewArray split (AdamUpdater: m then v;
+# AdaDeltaUpdater: msg then msdx; AMSGradUpdater: m, v, vHat). Layers walk in
+# the same order as the param flattening (MultiLayerUpdater: network layers;
+# ComputationGraphUpdater.getOrderedLayers: topological order); variables walk
+# in paramTable order = the per-layer flat layout above. BatchNorm mean/var
+# use NoOp (BatchNormalization.java:144-155) — zero state, but they BREAK
+# block contiguity.
+
+# our opt_state dict keys, in the order ND4J splits the block state view
+_STATE_KEYS = {
+    "sgd": [], "noop": [],
+    "nesterovs": ["v"],          # NesterovsUpdater: v (momentum)
+    "adagrad": ["h"],            # AdaGradUpdater: historicalGradient
+    "rmsprop": ["c"],            # RmsPropUpdater: lastGradient
+    "adadelta": ["eg", "edx"],   # AdaDeltaUpdater: msg, msdx
+    "adam": ["m", "v"], "nadam": ["m", "v"],
+    "adamax": ["m", "v"],        # AdaMaxUpdater: m, u
+    "amsgrad": ["m", "v", "vmax"],  # AMSGradUpdater: m, v, vHat
+}
+
+
+def _dl4j_var_sizes(cfg, in_type: InputType) -> List[Tuple[str, int]]:
+    """Per-variable (kind, size) in DL4J paramTable order — MUST mirror the
+    consumption order of ``_map_layer_params``. kind: 'train' uses the
+    layer's updater; 'stats' is BN mean/var (NoOp)."""
+    from deeplearning4j_tpu.nn import layers as L
+
+    name = type(cfg).__name__
+    if isinstance(cfg, L.Conv2D) and not isinstance(cfg, L.Deconv2D):
+        n_in = cfg.n_in if cfg.n_in else in_type.channels
+        kh, kw = cfg.kernel
+        out = [("train", cfg.n_out)] if cfg.has_bias else []
+        return out + [("train", cfg.n_out * n_in * kh * kw)]
+    if isinstance(cfg, (L.GravesLSTM, L.LSTM)):
+        H = cfg.n_out
+        n_in = cfg.n_in if cfg.n_in else in_type.size
+        rw = H * (4 * H + (3 if isinstance(cfg, L.GravesLSTM) else 0))
+        return [("train", n_in * 4 * H), ("train", rw), ("train", 4 * H)]
+    if isinstance(cfg, L.SimpleRnn):
+        H = cfg.n_out
+        n_in = cfg.n_in if cfg.n_in else in_type.size
+        return [("train", n_in * H), ("train", H * H), ("train", H)]
+    if isinstance(cfg, L.BatchNorm):
+        n = in_type.channels if in_type.kind == "conv" else in_type.flat_size()
+        out = [("train", n), ("train", n)] if cfg.use_gamma_beta else []
+        return out + [("stats", n), ("stats", n)]
+    if name in ("Dense", "OutputLayer", "RnnOutputLayer", "Embedding"):
+        n_out = cfg.n_out
+        n_in = cfg.n_in if cfg.n_in else in_type.flat_size()
+        out = [("train", n_in * n_out)]
+        if getattr(cfg, "has_bias", True):
+            out.append(("train", n_out))
+        return out
+    return []
+
+
+def _spec_state_keys(spec: Optional[dict]) -> List[str]:
+    t = (spec or {}).get("type", "sgd")
+    if t not in _STATE_KEYS:
+        raise ValueError(f"unknown updater type {t!r} in updater-state mapping")
+    return _STATE_KEYS[t]
+
+
+def _canon_spec(spec: Optional[dict]) -> dict:
+    """Normalize an updater spec (fill defaults, drop non-identity fields) so
+    block-equality compares like DL4J's IUpdater.equals — a layer whose JSON
+    omits a default field must still merge with its neighbors."""
+    from deeplearning4j_tpu.train.updaters import normalize_updater
+
+    out = dict(normalize_updater(spec if spec else {"type": "sgd"}))
+    out.pop("schedule", None)
+    return out
+
+
+def _updater_var_blocks(layer_entries, spec_for_entry):
+    """Shared import/export block segmentation. ``layer_entries``: ordered
+    [(cfg, in_type)]-like; ``spec_for_entry(li)`` -> that layer's canonical
+    trainable-var updater spec. Returns (var_recs, blocks) where var_recs =
+    [(li, vi, size, spec_json, spec)] and blocks groups contiguous equal
+    spec_json runs, mirroring BaseMultiLayerUpdater.java:56-127."""
+    var_recs = []
+    noop_json = json.dumps(_canon_spec({"type": "noop"}), sort_keys=True)
+    for li, (cfg, in_type) in enumerate(layer_entries):
+        spec = spec_for_entry(li)
+        spec_json = json.dumps(spec, sort_keys=True)
+        for vi, (kind, size) in enumerate(_dl4j_var_sizes(cfg, in_type)):
+            if kind == "stats":
+                var_recs.append((li, vi, size, noop_json, {"type": "noop"}))
+            else:
+                var_recs.append((li, vi, size, spec_json, spec))
+    blocks: List[Tuple[dict, list]] = []
+    for rec in var_recs:
+        if blocks and blocks[-1][1][-1][3] == rec[3]:
+            blocks[-1][1].append(rec)
+        else:
+            blocks.append((rec[4], [rec]))
+    return var_recs, blocks
+
+
+def _consume_updater_state(layer_entries, flat: np.ndarray, global_spec: dict):
+    """layer_entries: ordered [(cfg, layer_json_dict, in_type)]. Returns
+    {layer_pos: {acc_key: params-shaped-dict}} with every accumulator mapped
+    through the same layout conversions as the weights (an Adam ``m`` for a
+    conv W permutes (out,in,kh,kw)->(kh,kw,in,out) exactly like W itself)."""
+    gspec = _canon_spec(global_spec)
+
+    def spec_for(li):
+        lspec = _parse_updater(layer_entries[li][1])
+        return _canon_spec(lspec) if lspec else gspec
+
+    _, blocks = _updater_var_blocks(
+        [(cfg, it) for cfg, _d, it in layer_entries], spec_for)
+
+    pos = 0
+    segs: Dict[Tuple[int, int, str], np.ndarray] = {}
+    for spec, recs in blocks:
+        for key in _spec_state_keys(spec):
+            for li, vi, size, _, _ in recs:
+                seg, pos = _take(flat, pos, size)
+                segs[(li, vi, key)] = seg
+    if pos != flat.size:
+        raise ValueError(
+            f"updaterState.bin has {flat.size} values but the configuration's "
+            f"updater blocks consume {pos} — block layout mismatch")
+
+    out: Dict[int, Dict[str, dict]] = {}
+    for li, (cfg, d, in_type) in enumerate(layer_entries):
+        sizes = _dl4j_var_sizes(cfg, in_type)
+        keys = {k for (l2, _, k) in segs if l2 == li}
+        for key in sorted(keys):
+            pieces = [segs.get((li, vi, key), np.zeros(size, np.float32))
+                      for vi, (_, size) in enumerate(sizes)]
+            fake = np.concatenate(pieces) if pieces else np.zeros(0, np.float32)
+            p, _st, _ = _map_layer_params(cfg, d, fake, 0, in_type)
+            out.setdefault(li, {})[key] = p
+    return out
+
+
+def _merge_opt_state(existing, accs: Dict[str, dict]):
+    """Overlay imported accumulators onto a layer's initialized opt_state,
+    keeping dtype (mixed-precision keeps f32 accumulators)."""
+    import jax.numpy as jnp
+
+    if not isinstance(existing, dict):
+        return existing
+    new = dict(existing)
+    for key, tree in accs.items():
+        if key not in new:
+            continue
+        cur = new[key]
+        new[key] = {k: jnp.asarray(v, dtype=np.asarray(cur[k]).dtype
+                                   if isinstance(cur, dict) and k in cur
+                                   else np.float32)
+                    for k, v in tree.items()}
+    return new
+
+
+def _updater_to_dl4j_json(spec: dict) -> dict:
+    """Our updater spec -> DL4J iUpdater WRAPPER_OBJECT JSON (inverse of
+    ``_parse_updater``)."""
+    names = {"sgd": "Sgd", "nesterovs": "Nesterovs", "adam": "Adam",
+             "adamax": "AdaMax", "nadam": "Nadam", "amsgrad": "AMSGrad",
+             "adagrad": "AdaGrad", "adadelta": "AdaDelta",
+             "rmsprop": "RmsProp", "noop": "NoOp"}
+    body: Dict[str, Any] = {}
+    if "lr" in spec:
+        body["learningRate"] = spec["lr"]
+    for ours, theirs in (("beta1", "beta1"), ("beta2", "beta2"),
+                         ("eps", "epsilon"), ("momentum", "momentum"),
+                         ("decay", "rmsDecay"), ("rho", "rho")):
+        if ours in spec:
+            body[theirs] = spec[ours]
+    return {names.get(spec.get("type", "sgd"), "Sgd"): body}
+
+
+# ---------------------------------------------------------------------------
 # Import
 # ---------------------------------------------------------------------------
 
@@ -482,13 +672,24 @@ def import_dl4j_zip(path: str, input_type: Optional[InputType] = None):
         conf = json.loads(zf.read("configuration.json").decode("utf-8"))
         names = set(zf.namelist())
         coeff = zf.read("coefficients.bin") if "coefficients.bin" in names else b""
+        updater_bin = (zf.read("updaterState.bin")
+                       if "updaterState.bin" in names else b"")
 
     if "vertices" in conf and "confs" not in conf:
         parsed = _parse_cg_conf(conf)
         model = _import_dl4j_graph_conf(conf, input_type, parsed=parsed)
         if coeff:
             flat = read_nd4j(io.BytesIO(coeff)).ravel().astype(np.float32)
-            _map_cg_weights(model, parsed, flat)
+            uflat = (read_nd4j(io.BytesIO(updater_bin)).ravel().astype(np.float32)
+                     if updater_bin else None)
+            _map_cg_weights(model, parsed, flat, uflat)
+            # iterationCount lives on each LayerVertex's NeuralNetConfiguration
+            for _vn, (vt, body) in parsed[3].items():
+                if vt == "LayerVertex":
+                    it_count = (body.get("layerConf") or {}).get("iterationCount")
+                    if it_count:
+                        model.iteration = int(it_count)
+                        break
             model.weights_imported = True
         else:
             model.weights_imported = False  # config-only zip: fresh init
@@ -535,6 +736,8 @@ def import_dl4j_zip(path: str, input_type: Optional[InputType] = None):
     new_params = list(model.params)
     new_state = list(model.state)
     li = 0  # index over original (non-preprocessor) layers
+    entries = []          # (cfg, layer_json, in_type) in flatten order
+    entry_model_idx = []  # model layer index per entry
     import jax.numpy as jnp
 
     for idx, lcfg in enumerate(model.layers):
@@ -554,6 +757,8 @@ def import_dl4j_zip(path: str, input_type: Optional[InputType] = None):
             new_params[idx] = {k: jnp.asarray(v) for k, v in p.items()}
         if st:
             new_state[idx] = {k: jnp.asarray(v) for k, v in st.items()}
+        entries.append((cfg, layer_dicts[li][1], in_type))
+        entry_model_idx.append(idx)
         li += 1
     if pos != flat.size:
         raise ValueError(
@@ -561,8 +766,19 @@ def import_dl4j_zip(path: str, input_type: Optional[InputType] = None):
             f"consumes {pos} — layer/param layout mismatch")
     model.params = tuple(new_params)
     model.state = tuple(new_state)
-    model.opt_state = tuple(
-        u.init(p) for u, p in zip(model._updaters, model.params))
+    new_opt = [u.init(p) for u, p in zip(model._updaters, model.params)]
+    if updater_bin:
+        # restore optimizer accumulators (ModelSerializer.java:109-127) so
+        # training resumes with the reference's Adam moments etc.
+        from deeplearning4j_tpu.train.updaters import normalize_updater
+        uflat = read_nd4j(io.BytesIO(updater_bin)).ravel().astype(np.float32)
+        gspec = normalize_updater(model.conf.updater)
+        mapped = _consume_updater_state(entries, uflat, gspec)
+        for li2, accs in mapped.items():
+            idx = entry_model_idx[li2]
+            new_opt[idx] = _merge_opt_state(new_opt[idx], accs)
+    model.opt_state = tuple(new_opt)
+    model.iteration = int(confs[0].get("iterationCount", 0) or 0)
     model.weights_imported = True
     return model
 
@@ -790,15 +1006,20 @@ def _infer_cg_input_types(parsed, build_fn) -> List[InputType]:
         "the size — pass input_type= (one InputType per network input)")
 
 
-def _map_cg_weights(model, parsed, flat: np.ndarray):
+def _map_cg_weights(model, parsed, flat: np.ndarray,
+                    updater_flat: Optional[np.ndarray] = None):
     """Split coefficients.bin by the reference's topological walk and map
-    each LayerVertex segment into our per-vertex param/state dicts."""
+    each LayerVertex segment into our per-vertex param/state dicts. When
+    ``updater_flat`` is given, also restore optimizer accumulators
+    (ComputationGraphUpdater.getOrderedLayers walks the same topo order)."""
     import jax.numpy as jnp
 
     inputs, outputs, vertex_inputs, vertices = parsed
     order = _dl4j_topo_order(inputs, list(vertices), vertex_inputs)
     input_set = set(inputs)
     pos = 0
+    entries = []       # (cfg, layer_json, in_type) in flatten order
+    entry_names = []
     for name in order:
         if name in input_set:
             continue
@@ -818,12 +1039,21 @@ def _map_cg_weights(model, parsed, flat: np.ndarray):
             model.params[name] = {k: jnp.asarray(v) for k, v in p.items()}
         if st:
             model.state[name] = {k: jnp.asarray(v) for k, v in st.items()}
+        entries.append((rt.config, d, in_t))
+        entry_names.append(name)
     if pos != flat.size:
         raise ValueError(
             f"coefficients.bin has {flat.size} values but the CG configuration "
             f"consumes {pos} — vertex/param layout mismatch")
     model.opt_state = {
         name: u.init(model.params[name]) for name, u in model._updaters.items()}
+    if updater_flat is not None and updater_flat.size:
+        from deeplearning4j_tpu.train.updaters import normalize_updater
+        gspec = normalize_updater(model.conf.updater)
+        mapped = _consume_updater_state(entries, updater_flat, gspec)
+        for li, accs in mapped.items():
+            name = entry_names[li]
+            model.opt_state[name] = _merge_opt_state(model.opt_state[name], accs)
 
 
 def _import_dl4j_graph_conf(conf: dict, input_type, parsed=None):
@@ -1050,12 +1280,70 @@ def _export_layer(cfg, params: dict, state: dict, in_type: InputType) -> Tuple[O
     raise ValueError(f"export_dl4j_zip: layer {name} not supported")
 
 
+def _export_layer_spec(cfg, gspec: dict) -> dict:
+    """The canonical updater spec a layer's trainable vars use on export:
+    per-layer override first (LayerConfig.updater), else the model global;
+    frozen layers are NoOp."""
+    if not getattr(cfg, "trainable", True):
+        return _canon_spec({"type": "noop"})
+    lspec = getattr(cfg, "updater", None)
+    return _canon_spec(lspec) if lspec else gspec
+
+
+def _export_updater_state(model, export_entries) -> np.ndarray:
+    """Flatten optimizer accumulators into the reference's updater-block
+    layout (inverse of ``_consume_updater_state``). ``export_entries``:
+    ordered [(cfg, in_type, model_idx)]."""
+    gspec = _canon_spec(model.conf.updater)
+
+    def spec_for(li):
+        return _export_layer_spec(export_entries[li][0], gspec)
+
+    # per-(entry, var) accumulator segments in DL4J per-layer layout
+    seg_of: Dict[Tuple[int, int, str], np.ndarray] = {}
+    for li, (cfg, in_type, idx) in enumerate(export_entries):
+        sizes = _dl4j_var_sizes(cfg, in_type)
+        opt = model.opt_state[idx]
+        keys = _spec_state_keys(spec_for(li))
+        if keys and isinstance(opt, dict):
+            for key in keys:
+                tree = opt.get(key)
+                if tree is None:
+                    continue
+                # accumulators flatten exactly like the params themselves;
+                # BN mean/var (stats) have no accumulator — zero-filled here
+                # and dropped below
+                np_tree = {k: np.asarray(v, np.float32) for k, v in tree.items()}
+                zero_state = {k: np.zeros(np.shape(v), np.float32)
+                              for k, v in (model.state[idx] or {}).items()}
+                _, seg = _export_layer(cfg, np_tree, zero_state, in_type)
+                off = 0
+                for vi, (kind, size) in enumerate(sizes):
+                    if kind == "train":
+                        seg_of[(li, vi, key)] = seg[off:off + size]
+                    off += size
+
+    _, blocks = _updater_var_blocks(
+        [(cfg, it) for cfg, it, _idx in export_entries], spec_for)
+    pieces = []
+    for spec, recs in blocks:
+        for key in _spec_state_keys(spec):
+            for li, vi, size, _, _ in recs:
+                pieces.append(seg_of.get((li, vi, key),
+                                         np.zeros(size, np.float32)))
+    return (np.concatenate(pieces).astype(np.float32)
+            if pieces else np.zeros((0,), np.float32))
+
+
 def export_dl4j_zip(model, path: str):
     """Write a MultiLayerNetwork in the reference's zip format
-    (configuration.json + coefficients.bin) so DL4J can load our models."""
+    (configuration.json + coefficients.bin + updaterState.bin) so DL4J can
+    load our models and resume training with the optimizer state intact."""
     mlc = model.conf
+    gspec = _canon_spec(mlc.updater)
     confs = []
     segs = []
+    export_entries = []  # (cfg, in_type, model layer idx)
     for idx, cfg in enumerate(model.layers):
         if type(cfg).__module__.endswith("preprocessors"):
             continue
@@ -1067,8 +1355,14 @@ def export_dl4j_zip(model, path: str):
         obj, seg = _export_layer(cfg, model.params[idx] or {},
                                  model.state[idx] or {}, in_type)
         if obj is not None:
-            confs.append({"layer": obj, "seed": mlc.seed})
+            t = next(iter(obj))
+            if _dl4j_var_sizes(cfg, in_type) and getattr(cfg, "trainable", True):
+                obj[t].setdefault(
+                    "iUpdater", _updater_to_dl4j_json(_export_layer_spec(cfg, gspec)))
+            confs.append({"layer": obj, "seed": mlc.seed,
+                          "iterationCount": int(getattr(model, "iteration", 0))})
             segs.append(seg)
+            export_entries.append((cfg, in_type, idx))
 
     preprocs = {}
     it = mlc.input_type
@@ -1086,6 +1380,11 @@ def export_dl4j_zip(model, path: str):
     flat = np.concatenate(segs) if segs else np.zeros((0,), np.float32)
     buf = io.BytesIO()
     write_nd4j(buf, flat[None, :], "FLOAT")
+    ustate = _export_updater_state(model, export_entries)
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
         zf.writestr("configuration.json", json.dumps(conf_json))
         zf.writestr("coefficients.bin", buf.getvalue())
+        if ustate.size:
+            ubuf = io.BytesIO()
+            write_nd4j(ubuf, ustate[None, :], "FLOAT")
+            zf.writestr("updaterState.bin", ubuf.getvalue())

@@ -325,6 +325,314 @@ class TestRoundTripEdgeCases:
             export_dl4j_zip(m, str(tmp_path / "g.zip"))
 
 
+class TestUpdaterState:
+    """updaterState.bin mapping (BaseMultiLayerUpdater block layout: one
+    [m|v] view per contiguous same-updater block; BN mean/var are NoOp and
+    split blocks)."""
+
+    B1, B2, EPS, LR = 0.9, 0.999, 1e-8, 0.01
+
+    def _adam_json(self):
+        return {"Adam": {"learningRate": self.LR, "beta1": self.B1,
+                         "beta2": self.B2, "epsilon": self.EPS}}
+
+    def _dense_zip(self, path, iteration=7):
+        """dense(4->3,relu) + output(3->2,softmax), Adam everywhere: a single
+        updater block [m(W1,b1,W2,b2) | v(...)]."""
+        rs = np.random.RandomState(11)
+        W1 = rs.randn(4, 3).astype(np.float32) * 0.5
+        b1 = rs.randn(3).astype(np.float32) * 0.1
+        W2 = rs.randn(3, 2).astype(np.float32) * 0.5
+        b2 = rs.randn(2).astype(np.float32) * 0.1
+        flat = np.concatenate([W1.ravel(order="F"), b1,
+                               W2.ravel(order="F"), b2])
+        mm = rs.rand(flat.size).astype(np.float32) * 0.1
+        vv = rs.rand(flat.size).astype(np.float32) * 0.01
+        ustate = np.concatenate([mm, vv])
+        conf = {
+            "backprop": True, "backpropType": "Standard",
+            "confs": [
+                {"seed": 1, "iterationCount": iteration,
+                 "layer": {"dense": {
+                     "nin": 4, "nout": 3, "activationFn": {"ReLU": {}},
+                     "iUpdater": self._adam_json()}}},
+                {"layer": {"output": {
+                    "nin": 3, "nout": 2, "activationFn": {"Softmax": {}},
+                    "iUpdater": self._adam_json(),
+                    "lossFn": {"@class":
+                               "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"}}}},
+            ],
+            "inputPreProcessors": {},
+        }
+        b = io.BytesIO()
+        write_nd4j(b, flat[None, :], "FLOAT")
+        u = io.BytesIO()
+        write_nd4j(u, ustate[None, :], "FLOAT")
+        with zipfile.ZipFile(path, "w") as zf:
+            zf.writestr("configuration.json", json.dumps(conf))
+            zf.writestr("coefficients.bin", b.getvalue())
+            zf.writestr("updaterState.bin", u.getvalue())
+        return dict(W1=W1, b1=b1, W2=W2, b2=b2, m=mm, v=vv)
+
+    def test_adam_state_restored_in_our_layout(self, tmp_path):
+        p = str(tmp_path / "m.zip")
+        ref = self._dense_zip(p)
+        model = import_dl4j_zip(p)
+        assert model.iteration == 7
+        # block var order: W1(12), b1(3), W2(6), b2(2)
+        m = ref["m"]
+        exp_mW1 = m[:12].reshape(4, 3, order="F")
+        exp_mb1 = m[12:15]
+        exp_mW2 = m[15:21].reshape(3, 2, order="F")
+        exp_mb2 = m[21:23]
+        li = [i for i, l in enumerate(model.layers)
+              if not type(l).__module__.endswith("preprocessors")]
+        s0, s1 = model.opt_state[li[0]], model.opt_state[li[1]]
+        np.testing.assert_allclose(np.asarray(s0["m"]["W"]), exp_mW1, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(s0["m"]["b"]), exp_mb1, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(s1["m"]["W"]), exp_mW2, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(s1["m"]["b"]), exp_mb2, rtol=1e-6)
+        v = ref["v"]
+        np.testing.assert_allclose(np.asarray(s1["v"]["b"]), v[21:23], rtol=1e-6)
+
+    def test_first_post_restore_update_is_reference_adam_math(self, tmp_path):
+        """After restore, step one batch and check the parameter delta obeys
+        the Adam recurrence with the RESTORED m/v and the RESTORED iteration
+        count (t=8 bias correction), for the actual gradient (recovered from
+        the m update — independent of the loss implementation)."""
+        p = str(tmp_path / "m.zip")
+        self._dense_zip(p, iteration=7)
+        model = import_dl4j_zip(p)
+        li = [i for i, l in enumerate(model.layers)
+              if not type(l).__module__.endswith("preprocessors")]
+        idx = li[0]
+        W_before = np.asarray(model.params[idx]["W"], np.float64)
+        m_before = np.asarray(model.opt_state[idx]["m"]["W"], np.float64)
+        v_before = np.asarray(model.opt_state[idx]["v"]["W"], np.float64)
+        rs = np.random.RandomState(0)
+        x = rs.rand(8, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 8)]
+        model.fit((x, y))
+        W_after = np.asarray(model.params[idx]["W"], np.float64)
+        m_after = np.asarray(model.opt_state[idx]["m"]["W"], np.float64)
+        v_after = np.asarray(model.opt_state[idx]["v"]["W"], np.float64)
+        g = (m_after - self.B1 * m_before) / (1.0 - self.B1)
+        np.testing.assert_allclose(
+            v_after, self.B2 * v_before + (1 - self.B2) * g * g,
+            rtol=1e-4, atol=1e-8)
+        t = 8.0  # restored iteration 7 -> first step bias-corrects with t=8
+        bc1, bc2 = 1 - self.B1 ** t, 1 - self.B2 ** t
+        expected_delta = -self.LR * (m_after / bc1) / (
+            np.sqrt(v_after / bc2) + self.EPS)
+        np.testing.assert_allclose(W_after - W_before, expected_delta,
+                                   rtol=1e-3, atol=1e-7)
+
+    def test_bn_mean_var_split_blocks(self, tmp_path):
+        """conv + BN + output with Adam: BN mean/var (NoOp) end block 1, so
+        the state layout is [m(conv.b,conv.W,bn.g,bn.b)|v(...)] then
+        [m(out.W,out.b)|v(...)]."""
+        rs = np.random.RandomState(5)
+        convB = rs.randn(2).astype(np.float32) * 0.1
+        convW = rs.randn(2, 1, 3, 3).astype(np.float32) * 0.5
+        gam = np.abs(rs.randn(2)).astype(np.float32)
+        bet = rs.randn(2).astype(np.float32) * 0.1
+        mean = rs.randn(2).astype(np.float32) * 0.1
+        var = np.abs(rs.randn(2)).astype(np.float32) + 1.0
+        outW = rs.randn(32, 3).astype(np.float32) * 0.3   # 2ch * 4x4
+        outB = rs.randn(3).astype(np.float32) * 0.1
+        flat = np.concatenate([
+            convB, convW.ravel(), gam, bet, mean, var,
+            outW.ravel(order="F"), outB])
+        blk1 = 2 + 18 + 2 + 2    # conv.b, conv.W, gamma, beta
+        blk2 = 96 + 3            # out.W, out.b
+        m1 = rs.rand(blk1).astype(np.float32) * 0.1
+        v1 = rs.rand(blk1).astype(np.float32) * 0.01
+        m2 = rs.rand(blk2).astype(np.float32) * 0.1
+        v2 = rs.rand(blk2).astype(np.float32) * 0.01
+        ustate = np.concatenate([m1, v1, m2, v2])
+        conf = {
+            "backprop": True, "backpropType": "Standard",
+            "confs": [
+                {"seed": 1, "layer": {"convolution": {
+                    "nin": 1, "nout": 2, "kernelSize": [3, 3],
+                    "stride": [1, 1], "padding": [0, 0],
+                    "convolutionMode": "Truncate", "hasBias": True,
+                    "activationFn": {"ReLU": {}},
+                    "iUpdater": self._adam_json()}}},
+                {"layer": {"batchNormalization": {
+                    "decay": 0.9, "eps": 1e-5,
+                    "iUpdater": self._adam_json()}}},
+                {"layer": {"output": {
+                    "nin": 32, "nout": 3, "activationFn": {"Softmax": {}},
+                    "iUpdater": self._adam_json(),
+                    "lossFn": {"@class":
+                               "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"}}}},
+            ],
+            "inputPreProcessors": {"0": {"feedForwardToCnn": {
+                "inputHeight": 6, "inputWidth": 6, "numChannels": 1}}},
+        }
+        b = io.BytesIO()
+        write_nd4j(b, flat[None, :], "FLOAT")
+        u = io.BytesIO()
+        write_nd4j(u, ustate[None, :], "FLOAT")
+        p = str(tmp_path / "bn.zip")
+        with zipfile.ZipFile(p, "w") as zf:
+            zf.writestr("configuration.json", json.dumps(conf))
+            zf.writestr("coefficients.bin", b.getvalue())
+            zf.writestr("updaterState.bin", u.getvalue())
+        model = import_dl4j_zip(p)
+        li = [i for i, l in enumerate(model.layers)
+              if not type(l).__module__.endswith("preprocessors")]
+        s_conv = model.opt_state[li[0]]
+        s_bn = model.opt_state[li[1]]
+        s_out = model.opt_state[li[2]]
+        # conv m: [b(2) | W(18 C-order)] -> our (kh,kw,in,out)
+        np.testing.assert_allclose(np.asarray(s_conv["m"]["b"]), m1[:2], rtol=1e-6)
+        exp_mW = np.transpose(m1[2:20].reshape(2, 1, 3, 3), (2, 3, 1, 0))
+        np.testing.assert_allclose(np.asarray(s_conv["m"]["W"]), exp_mW, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(s_bn["v"]["gamma"]), v1[20:22], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(s_bn["v"]["beta"]), v1[22:24], rtol=1e-6)
+        # block 2: out.W m in F-order, rows permuted (c,h,w)->(h,w,c) exactly
+        # like W itself (dense-after-conv flatten-order conversion)
+        perm = np.arange(32).reshape(2, 4, 4).transpose(1, 2, 0).ravel()
+        exp_out_mW = m2[:96].reshape(32, 3, order="F")[perm]
+        np.testing.assert_allclose(
+            np.asarray(s_out["m"]["W"]), exp_out_mW, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(s_out["v"]["b"]), v2[96:], rtol=1e-6)
+
+    def test_mixed_per_layer_updaters(self, tmp_path):
+        """dense(Adam) + output(RmsProp): two blocks [m|v] then [c], and the
+        imported model honors the per-layer updater override."""
+        rs = np.random.RandomState(9)
+        W1 = rs.randn(4, 3).astype(np.float32)
+        b1 = rs.randn(3).astype(np.float32)
+        W2 = rs.randn(3, 2).astype(np.float32)
+        b2 = rs.randn(2).astype(np.float32)
+        flat = np.concatenate([W1.ravel(order="F"), b1,
+                               W2.ravel(order="F"), b2])
+        m1 = rs.rand(15).astype(np.float32)
+        v1 = rs.rand(15).astype(np.float32)
+        c2 = rs.rand(8).astype(np.float32)
+        ustate = np.concatenate([m1, v1, c2])
+        conf = {
+            "backprop": True, "backpropType": "Standard",
+            "confs": [
+                {"seed": 1, "layer": {"dense": {
+                    "nin": 4, "nout": 3, "activationFn": {"ReLU": {}},
+                    "iUpdater": self._adam_json()}}},
+                {"layer": {"output": {
+                    "nin": 3, "nout": 2, "activationFn": {"Softmax": {}},
+                    "iUpdater": {"RmsProp": {"learningRate": 0.1,
+                                             "rmsDecay": 0.95,
+                                             "epsilon": 1e-8}},
+                    "lossFn": {"@class":
+                               "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"}}}},
+            ],
+            "inputPreProcessors": {},
+        }
+        b = io.BytesIO()
+        write_nd4j(b, flat[None, :], "FLOAT")
+        u = io.BytesIO()
+        write_nd4j(u, ustate[None, :], "FLOAT")
+        p = str(tmp_path / "mix.zip")
+        with zipfile.ZipFile(p, "w") as zf:
+            zf.writestr("configuration.json", json.dumps(conf))
+            zf.writestr("coefficients.bin", b.getvalue())
+            zf.writestr("updaterState.bin", u.getvalue())
+        model = import_dl4j_zip(p)
+        li = [i for i, l in enumerate(model.layers)
+              if not type(l).__module__.endswith("preprocessors")]
+        assert model.layers[li[1]].updater["type"] == "rmsprop"
+        s0, s1 = model.opt_state[li[0]], model.opt_state[li[1]]
+        np.testing.assert_allclose(np.asarray(s0["v"]["b"]), v1[12:15], rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(s1["c"]["W"]), c2[:6].reshape(3, 2, order="F"), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(s1["c"]["b"]), c2[6:], rtol=1e-6)
+
+    def test_missing_default_fields_still_merge_blocks(self, tmp_path):
+        """A layer whose Adam JSON omits epsilon must merge into the same
+        block as a fully-specified Adam neighbor (DL4J IUpdater.equals with
+        defaults) — state is [m(all 4 vars) | v(all 4 vars)], not two
+        blocks."""
+        rs = np.random.RandomState(13)
+        W1 = rs.randn(4, 3).astype(np.float32)
+        b1 = rs.randn(3).astype(np.float32)
+        W2 = rs.randn(3, 2).astype(np.float32)
+        b2 = rs.randn(2).astype(np.float32)
+        flat = np.concatenate([W1.ravel(order="F"), b1,
+                               W2.ravel(order="F"), b2])
+        mm = rs.rand(23).astype(np.float32)
+        vv = rs.rand(23).astype(np.float32)
+        ustate = np.concatenate([mm, vv])  # ONE merged block
+        conf = {
+            "backprop": True, "backpropType": "Standard",
+            "confs": [
+                {"seed": 1, "layer": {"dense": {
+                    "nin": 4, "nout": 3, "activationFn": {"ReLU": {}},
+                    "iUpdater": self._adam_json()}}},
+                {"layer": {"output": {
+                    "nin": 3, "nout": 2, "activationFn": {"Softmax": {}},
+                    # epsilon/beta omitted: defaults equal the full spec
+                    "iUpdater": {"Adam": {"learningRate": self.LR,
+                                          "beta1": self.B1,
+                                          "beta2": self.B2}},
+                    "lossFn": {"@class":
+                               "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"}}}},
+            ],
+            "inputPreProcessors": {},
+        }
+        b = io.BytesIO()
+        write_nd4j(b, flat[None, :], "FLOAT")
+        u = io.BytesIO()
+        write_nd4j(u, ustate[None, :], "FLOAT")
+        p = str(tmp_path / "merge.zip")
+        with zipfile.ZipFile(p, "w") as zf:
+            zf.writestr("configuration.json", json.dumps(conf))
+            zf.writestr("coefficients.bin", b.getvalue())
+            zf.writestr("updaterState.bin", u.getvalue())
+        model = import_dl4j_zip(p)
+        li = [i for i, l in enumerate(model.layers)
+              if not type(l).__module__.endswith("preprocessors")]
+        s1 = model.opt_state[li[1]]
+        # merged layout: out.W m sits at mm[15:21], NOT at a per-layer offset
+        np.testing.assert_allclose(
+            np.asarray(s1["m"]["W"]), mm[15:21].reshape(3, 2, order="F"),
+            rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(s1["v"]["b"]), vv[21:], rtol=1e-6)
+
+    def test_export_roundtrip_preserves_state(self, tmp_path):
+        from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+        from deeplearning4j_tpu.nn.model import (
+            MultiLayerConfiguration, MultiLayerNetwork)
+        conf = MultiLayerConfiguration(
+            layers=(Dense(n_out=5, activation="relu"),
+                    OutputLayer(n_out=3, activation="softmax")),
+            input_type=InputType.feed_forward(4),
+            updater={"type": "adam", "lr": 0.01},
+            seed=3)
+        model = MultiLayerNetwork(conf).init()
+        rs = np.random.RandomState(2)
+        x = rs.rand(8, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 8)]
+        for _ in range(3):
+            model.fit((x, y))
+        p = str(tmp_path / "rt.zip")
+        export_dl4j_zip(model, p)
+        with zipfile.ZipFile(p) as zf:
+            assert "updaterState.bin" in zf.namelist()
+        back = import_dl4j_zip(p)
+        assert back.iteration == 3
+        for i in range(len(model.layers)):
+            a, b = model.opt_state[i], back.opt_state[i]
+            if not isinstance(a, dict):
+                continue
+            for key in ("m", "v"):
+                for leaf in a[key]:
+                    np.testing.assert_allclose(
+                        np.asarray(a[key][leaf]), np.asarray(b[key][leaf]),
+                        rtol=1e-5, atol=1e-7)
+
+
 class TestTransferOnImported:
     def test_surgery_on_imported_model(self, tmp_path):
         p = str(tmp_path / "cnn.zip")
