@@ -82,9 +82,11 @@ def _sparse_perplexity_rows(sqd: np.ndarray, perplexity: float,
     return p / np.maximum(p.sum(axis=1, keepdims=True), 1e-12)
 
 
-@functools.partial(jax.jit, static_argnames=("n_iter", "stop_lying_iter"))
+@functools.partial(jax.jit, static_argnames=("n_iter", "stop_lying_iter",
+                                              "switch_momentum_iter"))
 def _tsne_optimize(p, y0, learning_rate, momentum_init, momentum_final,
-                   n_iter: int, stop_lying_iter: int):
+                   n_iter: int, stop_lying_iter: int,
+                   switch_momentum_iter: int = 20, exaggeration: float = 4.0):
     n = p.shape[0]
     eye = jnp.eye(n, dtype=bool)
 
@@ -104,9 +106,10 @@ def _tsne_optimize(p, y0, learning_rate, momentum_init, momentum_final,
     def body(i, carry):
         y, vel, gains = carry
         lying = i < stop_lying_iter
-        pmat = jnp.where(lying, p * 4.0, p)
+        pmat = jnp.where(lying, p * exaggeration, p)
         g = grad_kl(y, pmat)
-        momentum = jnp.where(i < 20, momentum_init, momentum_final)
+        momentum = jnp.where(i < switch_momentum_iter, momentum_init,
+                             momentum_final)
         same_sign = (g > 0) == (vel > 0)
         gains = jnp.maximum(
             jnp.where(same_sign, gains * 0.8, gains + 0.2), 0.01
@@ -129,7 +132,12 @@ class Tsne:
     def __init__(self, n_components: int = 2, perplexity: float = 30.0,
                  learning_rate: float = 200.0, n_iter: int = 1000,
                  stop_lying_iteration: int = 250, momentum: float = 0.5,
-                 final_momentum: float = 0.8, seed: int = 12345):
+                 final_momentum: float = 0.8, seed: int = 12345,
+                 switch_momentum_iteration: int = 20,
+                 exaggeration: float = 4.0):
+        # reference Tsne.java defaults differ (switchMomentumIteration=100;
+        # classic BH-tSNE uses 12x early exaggeration) — both are exposed
+        # here and shared by the exact and barnes_hut paths
         self.n_components = n_components
         self.perplexity = perplexity
         self.learning_rate = learning_rate
@@ -137,6 +145,8 @@ class Tsne:
         self.stop_lying_iteration = stop_lying_iteration
         self.momentum = momentum
         self.final_momentum = final_momentum
+        self.switch_momentum_iteration = switch_momentum_iteration
+        self.exaggeration = exaggeration
         self.seed = seed
         self.embedding_: Optional[np.ndarray] = None
 
@@ -155,6 +165,8 @@ class Tsne:
         y = _tsne_optimize(
             p, y0, jnp.float32(self.learning_rate), jnp.float32(self.momentum),
             jnp.float32(self.final_momentum), self.n_iter, self.stop_lying_iteration,
+            switch_momentum_iter=self.switch_momentum_iteration,
+            exaggeration=float(self.exaggeration),
         )
         self.embedding_ = np.asarray(y)
         return self.embedding_
@@ -233,12 +245,14 @@ class BarnesHutTsne(Tsne):
         # auto-capped learning rate (Belkina et al. 2019: eta ~ n/exaggeration,
         # floored at 50): the momentum+gains loop oscillates on small n when
         # driven at the dense-path default of 200
-        lr = min(self.learning_rate, max(n / 4.0, 50.0))
+        lr = min(self.learning_rate, max(n / self.exaggeration, 50.0))
         for it in range(self.n_iter):
             lying = it < self.stop_lying_iteration
             g = barnes_hut_gradient(
-                y, row_p, col_p, val_p * (4.0 if lying else 1.0), self.theta)
-            momentum = self.momentum if it < 20 else self.final_momentum
+                y, row_p, col_p,
+                val_p * (self.exaggeration if lying else 1.0), self.theta)
+            momentum = (self.momentum if it < self.switch_momentum_iteration
+                        else self.final_momentum)
             same_sign = (g > 0) == (vel > 0)
             gains = np.maximum(np.where(same_sign, gains * 0.8, gains + 0.2), 0.01)
             vel = momentum * vel - lr * gains * g

@@ -350,7 +350,9 @@ def dl4j_layer_to_config(type_name: str, d: Dict[str, Any]):
 
 def _take(flat: np.ndarray, pos: int, n: int) -> Tuple[np.ndarray, int]:
     if pos + n > flat.size:
-        raise ValueError(f"coefficients.bin exhausted: need {pos + n}, have {flat.size}")
+        # shared by coefficients.bin AND updaterState.bin consumption
+        raise ValueError(
+            f"binary parameter stream exhausted: need {pos + n}, have {flat.size}")
     return flat[pos:pos + n], pos + n
 
 
@@ -1356,7 +1358,9 @@ def export_dl4j_zip(model, path: str):
                                  model.state[idx] or {}, in_type)
         if obj is not None:
             t = next(iter(obj))
-            if _dl4j_var_sizes(cfg, in_type) and getattr(cfg, "trainable", True):
+            if _dl4j_var_sizes(cfg, in_type):
+                # frozen layers export iUpdater NoOp so the import side
+                # segments updaterState.bin identically (no accumulators)
                 obj[t].setdefault(
                     "iUpdater", _updater_to_dl4j_json(_export_layer_spec(cfg, gspec)))
             confs.append({"layer": obj, "seed": mlc.seed,

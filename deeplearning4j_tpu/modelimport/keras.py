@@ -581,6 +581,7 @@ def _sequential_from_config(model_config: dict) -> Tuple[MultiLayerConfiguration
     )
     cur_it = input_type
     pending_mask: Optional[float] = None
+    mask_consumed = False
     _rnn_classes = set(_RETURNS_SEQUENCES) | {"Bidirectional"}
     # rnn_later[i]: does any layer AFTER index i still need the mask?
     rnn_later = [False] * (len(layers_cfg) + 1)
@@ -597,6 +598,7 @@ def _sequential_from_config(model_config: dict) -> Tuple[MultiLayerConfiguration
             # defer: the next recurrent layer is wrapped in MaskZero so the
             # mask is derived from its input (recurrent/MaskZeroLayer.java)
             pending_mask = float(cfg.get("mask_value", 0.0))
+            mask_consumed = False  # a NEW mask must find its own consumer
             continue
         if cn == "Flatten" and cur_it.kind == "recurrent":
             # our Dense consumes [B,T,F] natively, so no auto-preprocessor
@@ -633,6 +635,7 @@ def _sequential_from_config(model_config: dict) -> Tuple[MultiLayerConfiguration
 
             conv = MaskZero(rnn=conv, mask_value=pending_mask)
             pending_mask = 0.0
+            mask_consumed = True
         elif (pending_mask is not None and rnn_later[i + 1]
                 and cn not in _mask_transparent):
             # a value-transforming layer between Masking and a later RNN
@@ -652,6 +655,16 @@ def _sequential_from_config(model_config: dict) -> Tuple[MultiLayerConfiguration
             cur_it = conv.output_type(cur_it)
         except Exception:
             pass  # shape tracking is best-effort; MLN resolution re-derives
+    if pending_mask is not None and not mask_consumed:
+        # Keras silently lets a mask die at a non-mask-consuming layer
+        # (e.g. Masking->Dense); we import the layers but the masking is a
+        # no-op — surface that instead of dropping it silently
+        import warnings
+
+        warnings.warn(
+            "Keras Masking layer has no downstream RNN consumer — the mask "
+            "is dropped (padded steps are treated as real values)",
+            stacklevel=2)
     conf = MultiLayerConfiguration(layers=tuple(our_layers), input_type=input_type)
     return conf, names
 

@@ -18,7 +18,11 @@ gradients of the same math).
 
 CPU/tests: ``interpret=True`` runs the identical kernel in the Pallas
 interpreter; the layer's default ("auto") uses the kernel only on TPU and
-falls back to the XLA path elsewhere and for masked/dropout variants.
+falls back to the XLA path elsewhere and for masked (kmask) variants.
+Attention dropout is applied to the attention OUTPUT (not the probability
+matrix) in both paths — see MultiHeadAttention.apply in
+nn/layers/attention.py — so dropout is flash-compatible and does not gate
+the kernel.
 """
 
 from __future__ import annotations

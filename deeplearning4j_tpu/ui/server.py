@@ -252,14 +252,23 @@ class UIServer:
                                for r in records):
                         raise ValueError(
                             "records must be JSON objects with a session_id")
-                    for rec in records:
-                        kind = rec.pop("_kind", "update")
+                    # fully parse/stage the batch BEFORE the first put_* so
+                    # a failure anywhere leaves storage untouched
+                    staged = [(rec.pop("_kind", "update"), rec)
+                              for rec in records]
+                except Exception as e:  # any bad payload -> 400, keep serving
+                    self.send_response(400)
+                    self.end_headers()
+                    self.wfile.write(str(e).encode())
+                    return
+                try:
+                    for kind, rec in staged:
                         if kind == "static":
                             outer._remote_storage.put_static_info(rec)
                         else:
                             outer._remote_storage.put_update(rec)
-                except Exception as e:  # any bad payload -> 400, keep serving
-                    self.send_response(400)
+                except Exception as e:  # storage fault: 500, keep serving
+                    self.send_response(500)
                     self.end_headers()
                     self.wfile.write(str(e).encode())
                     return

@@ -600,6 +600,36 @@ class TestUpdaterState:
             rtol=1e-6)
         np.testing.assert_allclose(np.asarray(s1["v"]["b"]), vv[21:], rtol=1e-6)
 
+    def test_frozen_layer_export_roundtrip(self, tmp_path):
+        """A trainable=False layer exports iUpdater NoOp (no accumulators)
+        and the zip reads back cleanly."""
+        import dataclasses
+        from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+        from deeplearning4j_tpu.nn.model import (
+            MultiLayerConfiguration, MultiLayerNetwork)
+        conf = MultiLayerConfiguration(
+            layers=(dataclasses.replace(Dense(n_out=5, activation="relu"),
+                                        trainable=False),
+                    OutputLayer(n_out=3, activation="softmax")),
+            input_type=InputType.feed_forward(4),
+            updater={"type": "adam", "lr": 0.01}, seed=3)
+        model = MultiLayerNetwork(conf).init()
+        rs = np.random.RandomState(2)
+        x = rs.rand(8, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 8)]
+        model.fit((x, y))
+        p = str(tmp_path / "fr.zip")
+        export_dl4j_zip(model, p)
+        back = import_dl4j_zip(p)
+        # frozen layer's NoOp updater survives; output layer's Adam state too
+        li = [i for i, l in enumerate(back.layers)
+              if not type(l).__module__.endswith("preprocessors")]
+        assert back.layers[li[0]].updater["type"] == "noop"
+        a = model.opt_state[li[1]]
+        b = back.opt_state[li[1]]
+        np.testing.assert_allclose(np.asarray(a["m"]["W"]),
+                                   np.asarray(b["m"]["W"]), rtol=1e-5, atol=1e-7)
+
     def test_export_roundtrip_preserves_state(self, tmp_path):
         from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
         from deeplearning4j_tpu.nn.model import (
