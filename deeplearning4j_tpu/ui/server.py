@@ -252,8 +252,11 @@ class UIServer:
                                for r in records):
                         raise ValueError(
                             "records must be JSON objects with a session_id")
-                    # fully parse/stage the batch BEFORE the first put_* so
-                    # a failure anywhere leaves storage untouched
+                    # fully parse/stage the batch BEFORE the first put_*:
+                    # any VALIDATION failure leaves storage untouched (a
+                    # storage fault mid-apply can still persist a prefix —
+                    # put_* on validated dicts doesn't raise in the
+                    # in-memory/file storages shipped here)
                     staged = [(rec.pop("_kind", "update"), rec)
                               for rec in records]
                 except Exception as e:  # any bad payload -> 400, keep serving
