@@ -178,18 +178,16 @@ def bench_lenet5():
 def bench_resnet50():
     """BASELINE #2 — zoo ResNet50 @ 224x224, images/sec + analytic MFU.
 
-    Measured MFU diagnosis (v5e, b128, bf16, round 3): ~0.26. The residual
-    gap to the >0.4 target is conv-kernel shaped, not framework overhead:
-    (a) the 7x7 stem has C_in=3, which underfills the 128-lane MXU contraction
-    dimension; MLPerf-class implementations rewrite the stem via
-    space-to-depth, which changes the parameter layout away from reference
-    parity, so we keep the faithful stem; (b) the reference's ResNet-v1
-    bottleneck puts stride 2 on 1x1 convs (zoo/model/ResNet50.java), whose
-    strided-gather lowering is cheap in FLOPs but poor in MXU occupancy.
-    Batch 64->128 and folding BatchNorm to a per-channel bf16 scale/shift
-    (normalization.py) were the two levers that mattered (0.13 -> 0.26;
-    the E[x^2]-E[x]^2 stats form bought another ~0.01 but catastrophically
-    cancels for large-mean channels, so the stable shifted form stays)."""
+    Measured MFU (v5e, b128, bf16, round 4): ~0.28 — proven to be the
+    chip's ceiling for this op mix by the round-4 null experiment
+    (tools/null_resnet50.py: a from-scratch no-framework JAX step measures
+    0.288; full head-to-head in docs/PERF.md "Null experiment"). Levers
+    that mattered: batch 64->128, BatchNorm folded to per-channel bf16
+    scale/shift with the stable shifted-stats form (0.13 -> 0.26), and
+    round 4's REMOVAL of the round-3 strided-1x1 slice-then-matmul rewrite
+    (+12% then, -12% on the round-4 toolchain). The MLPerf-style
+    stem="space_to_depth" variant adds ~+5% but changes parameter layout
+    away from reference parity, so the faithful conv7 stem stays here."""
     import jax
     import jax.numpy as jnp
 

@@ -116,8 +116,11 @@ class MultiHeadAttention(LayerConfig):
 
             on_tpu = jax.default_backend() == "tpu"
             if self.use_flash is True or on_tpu:
+                # off-TPU (interpreter) the compiled XLA-remat backward is
+                # far faster than three interpreted Pallas kernels
                 return flash_attention(q, k, v, causal=self.causal,
-                                       interpret=not on_tpu)
+                                       interpret=not on_tpu,
+                                       bwd="pallas" if on_tpu else "xla")
         return local_attention(q, k, v, causal=self.causal, kmask=kmask)
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):

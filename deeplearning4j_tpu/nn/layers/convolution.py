@@ -99,22 +99,11 @@ class Conv2D(FeedForwardLayerConfig):
 
     def _conv(self, x, W, groups: int = 1):
         sh, sw = _pair(self.stride)
-        # Strided 1x1 conv == subsample-then-1x1: a 1x1 kernel with stride s
-        # and no padding only ever reads positions (0, s, 2s, ...), so slice
-        # first and run the conv dense. The strided-gather form underfills
-        # the MXU (ResNet-v1 bottlenecks put stride 2 on 1x1 convs —
-        # zoo/model/ResNet50.java); the sliced form is an ordinary matmul.
-        if (groups == 1 and (sh > 1 or sw > 1)
-                and _pair(self.kernel) == (1, 1)
-                and _pair(self.dilation) == (1, 1)
-                and _pair(self.padding) == (0, 0)):
-            return lax.conv_general_dilated(
-                x[:, ::sh, ::sw, :],
-                W,
-                window_strides=(1, 1),
-                padding=[(0, 0), (0, 0)],
-                dimension_numbers=DIMNUMS,
-            )
+        # NOTE: a slice-then-dense rewrite of strided 1x1 convs (the
+        # ResNet-v1 bottleneck pattern) was a +12% win in round 3 but a
+        # -12% LOSS on the round-4 toolchain — the strided-gather lowering
+        # improved and the explicit slice now breaks producer fusion. The
+        # null-experiment A/B lives in docs/PERF.md; keep the plain form.
         return lax.conv_general_dilated(
             x,
             W,

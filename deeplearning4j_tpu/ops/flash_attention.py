@@ -61,8 +61,9 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
     lse is stored as a ROW over a [BH, 1, t_pad] array: the natural
     column layout ([.., t_pad, 1]) lane-pads 128x on TPU, which as a
     per-layer vjp residual OOMs large models; the row layout only
-    sublane-pads 8x. 0 (not -inf) for padded/empty rows so the backward's
-    exp(s - lse) is exactly 0 there with no NaN paths."""
+    sublane-pads 8x. NOTE: zero-padded q rows get a real finite lse (they
+    still see valid keys); the backward's q_valid mask — not any lse
+    sentinel — is what keeps padded rows out of dk/dv."""
     qi = pl.program_id(1)
     # operands stay in their native dtype (bf16 keeps the MXU at full rate);
     # scores, softmax state and the accumulator are f32
@@ -103,8 +104,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
                            + (1 if block_q % block_k else 0))
     m, l, acc = lax.fori_loop(0, n_kb, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-    lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), 0.0)
-    lse_ref[0] = lse.reshape(1, block_q)
+    lse_ref[0] = (m + jnp.log(jnp.maximum(l, 1e-30))).reshape(1, block_q)
 
 
 def _pad_bh(x, t_pad):

@@ -104,6 +104,31 @@ class TestPallasBackward:
             assert a.dtype == jnp.bfloat16
             assert np.all(np.isfinite(np.asarray(a, np.float32)))
 
+    def test_bf16_numerics_close_to_f32_reference(self):
+        """The native-dtype matmul path (p cast to bf16 before the
+        accumulating dots) must stay within bf16 tolerance of the f32
+        dense reference — guards against a future change accumulating in
+        bf16."""
+        rs = np.random.RandomState(11)
+        qf, kf, vf = _qkv(rs, 2, 48, 2, 32)
+        qb, kb, vb = (x.astype(jnp.bfloat16) for x in (qf, kf, vf))
+        out_b = flash_attention(qb, kb, vb, causal=True, block_q=16,
+                                block_k=16, interpret=True)
+        ref = _reference(qf, kf, vf, True)
+        np.testing.assert_allclose(
+            np.asarray(out_b, np.float32), np.asarray(ref, np.float32),
+            rtol=2e-2, atol=2e-2)
+        gb = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=True, block_q=16, block_k=16,
+            interpret=True).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2))(qb, kb, vb)
+        gf = jax.grad(lambda q, k, v: jnp.sum(
+            _reference(q, k, v, True) ** 2), argnums=(0, 1, 2))(qf, kf, vf)
+        for a, b in zip(gb, gf):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=5e-2, atol=5e-2)
+
     def test_bad_bwd_flag_rejected(self):
         rs = np.random.RandomState(10)
         q, k, v = _qkv(rs, 1, 8, 1, 8)
