@@ -62,23 +62,37 @@ def AlexNet(height: int = 224, width: int = 224, channels: int = 3,
     )
 
 
-def _vgg_block(layers, n_convs: int, n_out: int):
+def _vgg_block(layers, n_convs: int, n_out: int, batch_norm: bool = False):
     for _ in range(n_convs):
-        layers.append(Conv2D(n_out=n_out, kernel=(3, 3), convolution_mode="same",
-                             activation="relu"))
+        if batch_norm:
+            layers.append(Conv2D(n_out=n_out, kernel=(3, 3),
+                                 convolution_mode="same",
+                                 activation="identity", has_bias=False))
+            layers.append(BatchNorm())
+            layers.append(ActivationLayer(activation="relu"))
+        else:
+            layers.append(Conv2D(n_out=n_out, kernel=(3, 3),
+                                 convolution_mode="same", activation="relu"))
     layers.append(Subsampling2D(kernel=(2, 2), stride=(2, 2)))
 
 
 def VGG16(height: int = 224, width: int = 224, channels: int = 3,
           num_classes: int = 1000, updater=None, seed: int = 12345,
-          dtype: str = "float32") -> MultiLayerConfiguration:
-    """VGG-16 (zoo/model/VGG16.java)."""
+          dtype: str = "float32", batch_norm: bool = False,
+          fc_dropout: float = 0.0,
+          fc_width: int = 4096) -> MultiLayerConfiguration:
+    """VGG-16 (zoo/model/VGG16.java).
+
+    ``batch_norm=True`` inserts BatchNorm after every conv (the torchvision
+    vgg16_bn variant); ``fc_dropout`` enables the classifier dropout the
+    reference ships commented out (VGG16.java:147-149); ``fc_width``
+    shrinks the classifier for small inputs/tests (reference: 4096)."""
     layers: list = []
     for n_convs, width_ in ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512)):
-        _vgg_block(layers, n_convs, width_)
+        _vgg_block(layers, n_convs, width_, batch_norm=batch_norm)
     layers += [
-        Dense(n_out=4096, activation="relu"),
-        Dense(n_out=4096, activation="relu"),
+        Dense(n_out=fc_width, activation="relu", dropout=fc_dropout),
+        Dense(n_out=fc_width, activation="relu", dropout=fc_dropout),
         OutputLayer(n_out=num_classes, activation="softmax", loss="mcxent"),
     ]
     return MultiLayerConfiguration(

@@ -24,16 +24,38 @@ module makes it a first-class trainer:
 - ``to_model()`` unravels the trained vectors back into an ordinary
   MultiLayerNetwork for inference/serialization/evaluation.
 
-v1 limitations (explicit, checked): layers with running state (BatchNorm) or
-rng needs (dropout), per-layer updater overrides, gradient normalization,
-constraints, and masks are rejected with clear errors — the DP/TP paths
-cover those; this trainer targets the deep feed-forward/conv stacks where
-pipeline memory scaling matters.
+v2 additions:
+
+- **BatchNorm**: train-mode normalization uses per-microbatch statistics
+  (standard GPipe semantics); with a data axis > 1 the normalization unit
+  is the per-device microbatch SHARD (no cross-shard sync-BN — collectives
+  cannot live inside the rank switch). Each stage emits its BN layers'
+  batch stats as a fixed-width [all means | all variances] aux vector per
+  microbatch; across data shards the variances combine with the stable
+  parallel-variance form (no E[x^2]-mean^2 cancellation), and the step
+  chains the running-stat EMA over microbatches in order. With data=1 and
+  n_micro=1 the trainer is EXACTLY the single-device full-batch step, BN
+  included; with data=1, n_micro>1 it matches a single-device run that
+  microbatches the same way — both asserted in test_gpipe.py.
+- **Dropout and weight noise**: per-(microbatch, layer) keys derived as
+  ``fold_in(fold_in(base_rng, micro), global_layer_index)`` (weight noise
+  additionally fold_in(., 0x5EED), exactly like MultiLayerNetwork._forward)
+  — a scheme a single-device reference reproduces exactly.
+- **Per-layer updater overrides**: supported when the override is the
+  same updater TYPE differing only in lr (incl. trainable=False == lr 0):
+  every updater here is linear in lr with internally-consistent state, so
+  a per-position scale vector on the stacked update is exact. Different
+  types / non-lr field diffs stay rejected.
+- **Per-stage rematerialization** (jax.checkpoint on every stage branch):
+  the classic GPipe activation-memory optimization.
+
+v2 limitations (explicit, checked): non-BN stateful layers, gradient
+normalization, constraints, and masks are rejected with clear errors —
+the DP/TP paths cover those.
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
@@ -109,31 +131,70 @@ class GPipeTrainer:
 
         self._build_stages()
         self.updater = make_updater(conf.updater)
+        self._update_scales = self._build_update_scales()
         self.opt_state = self.updater.init((self.stacked, self.head_params))
         self.iteration = 0
         self.epoch = 0
         self.listeners: list = []
         self._step = None
+        self._rng = jax.random.PRNGKey((conf.seed or 0) + 7919)
+
+    def _build_update_scales(self):
+        """Per-position lr scale [S, Lmax] for the stacked update + scalar
+        head scale. Per-layer overrides must be the conf updater's TYPE
+        differing only in lr; trainable=False scales to 0."""
+        from deeplearning4j_tpu.train.updaters import normalize_updater
+
+        base = dict(normalize_updater(self.conf.updater))
+        base_lr = float(base.get("lr", 0.0)) or 1.0
+
+        def layer_scale(layer) -> float:
+            if not getattr(layer, "trainable", True):
+                return 0.0
+            ov = getattr(layer, "updater", None)
+            if ov is None:
+                return 1.0
+            spec = dict(normalize_updater(ov))
+            if spec.get("type") != base.get("type"):
+                raise NotImplementedError(
+                    "GPipeTrainer v2: per-layer updater override of a "
+                    f"DIFFERENT type ({spec.get('type')} vs "
+                    f"{base.get('type')}) is unsupported")
+            rest_a = {k: v for k, v in spec.items() if k != "lr"}
+            rest_b = {k: v for k, v in base.items() if k != "lr"}
+            if rest_a != rest_b:
+                raise NotImplementedError(
+                    "GPipeTrainer v2: per-layer updater overrides may only "
+                    "differ in lr")
+            if base.get("type") == "adadelta":
+                return 1.0  # adadelta has no lr
+            return float(spec.get("lr", base_lr)) / base_lr
+
+        scale = np.ones(self.stacked.shape, np.float32)
+        for si, (s, e) in enumerate(self.stage_ranges):
+            off = 0
+            for gi in range(s, e):
+                n = sum(int(np.prod(np.shape(l))) for l in
+                        jax.tree_util.tree_leaves(self._ref.params[gi]))
+                scale[si, off:off + n] = layer_scale(self._ref.layers[gi])
+                off += n
+        return jnp.asarray(scale), jnp.float32(layer_scale(self.head_cfg))
 
     # -- validation --------------------------------------------------------
     def _validate(self):
+        from deeplearning4j_tpu.nn.layers import BatchNorm
+
         for i, layer in enumerate(self._ref.layers):
             name = type(layer).__name__
-            if jax.tree_util.tree_leaves(self._ref.state[i]):
+            if jax.tree_util.tree_leaves(self._ref.state[i]) and \
+                    not isinstance(layer, BatchNorm):
                 raise NotImplementedError(
-                    f"GPipeTrainer v1: layer {i} ({name}) carries running "
-                    "state (BatchNorm?) — use DP/TP for stateful nets")
-            if getattr(layer, "dropout", 0.0):
-                raise NotImplementedError(
-                    f"GPipeTrainer v1: layer {i} ({name}) uses dropout (rng "
-                    "plumbing through the pipe ring is not implemented)")
-            if getattr(layer, "updater", None) is not None:
-                raise NotImplementedError(
-                    "GPipeTrainer v1: per-layer updater overrides unsupported")
+                    f"GPipeTrainer v2: layer {i} ({name}) carries non-BN "
+                    "running state — use DP/TP for such nets")
             if getattr(layer, "gradient_normalization", None) or \
                     getattr(layer, "constraints", None):
                 raise NotImplementedError(
-                    "GPipeTrainer v1: gradient normalization / constraints "
+                    "GPipeTrainer v2: gradient normalization / constraints "
                     "unsupported")
 
     # -- stage construction ------------------------------------------------
@@ -173,55 +234,163 @@ class GPipeTrainer:
             ref.params[self.head_idx],
             NamedSharding(self.mesh, P()))
 
-        # per-stage branch: [Lmax], [mb, Fmax] -> [mb, Fmax]
+        # BN metadata per stage: (local pos, global layer idx, n_features,
+        # decay, feature offset). The aux vector is laid out as TWO halves,
+        # [all means | all variances]: a layout that is uniform across
+        # ranks, so the cross-data-shard variance combine (the stable
+        # parallel form, not E[x^2]-mean^2 cancellation) can run in shared
+        # post-switch code.
+        from deeplearning4j_tpu.nn.layers import BatchNorm
+
+        self._stage_bn = []
+        feat_widths = []
+        for si, (s, e) in enumerate(self.stage_ranges):
+            bns = []
+            off = 0
+            for lp, gi in enumerate(range(s, e)):
+                layer = ref.layers[gi]
+                if isinstance(layer, BatchNorm):
+                    n = int(np.shape(ref.state[gi]["mean"])[0])
+                    bns.append((lp, gi, n, float(layer.decay), off))
+                    off += n
+            self._stage_bn.append(bns)
+            feat_widths.append(off)
+        self.a_half = max(1, max(feat_widths) if feat_widths else 1)
+        self.a_max = 2 * self.a_half
+        # running stats, replicated (tiny [C] vectors), keyed by layer idx
+        self.bn_state = {
+            gi: {k: jnp.asarray(v, jnp.float32)
+                 for k, v in ref.state[gi].items()}
+            for bns in self._stage_bn for (_lp, gi, _n, _d, _off) in bns
+        }
+
+        # per-stage branch: [Lmax], [mb, Fmax], micro, rng
+        #   -> ([mb, Fmax], [A_max])
         def make_branch(i):
             unravel = unravels[i]
             layers = self._stage_layers[i]
             in_size, in_shape = self._in_sizes[i], self._in_shapes[i]
             length = self._stage_lens[i]
+            s0 = self.stage_ranges[i][0]
+            bn_at = {lp: (n, decay, off)
+                     for (lp, _gi, n, decay, off) in self._stage_bn[i]}
 
-            def branch(vec, xf):
+            def branch(vec, xf, micro, rng):
                 params = unravel(vec[:length])
                 x = xf[:, :in_size].reshape((xf.shape[0],) + tuple(in_shape))
                 x = x.astype(self._ref.dtype)
-                for layer, p in zip(layers, params):
-                    x, _ = layer.apply(p, {}, x, train=True, rng=None)
+                aux = jnp.zeros((self.a_max,), jnp.float32)
+                kmicro = jax.random.fold_in(rng, micro)
+                for lp, (layer, p) in enumerate(zip(layers, params)):
+                    # per-(micro, GLOBAL layer) key — reproducible by a
+                    # single-device microbatched reference
+                    lrng = jax.random.fold_in(kmicro, s0 + lp)
+                    if layer.weight_noise:
+                        # same keying as MultiLayerNetwork._forward
+                        p = layer.maybe_weight_noise(
+                            p, True, jax.random.fold_in(lrng, 0x5EED))
+                    if lp in bn_at:
+                        n, decay, off = bn_at[lp]
+                        zero = {"mean": jnp.zeros((n,), jnp.float32),
+                                "var": jnp.zeros((n,), jnp.float32)}
+                        x, ns = layer.apply(p, zero, x, train=True, rng=lrng)
+                        # state was 0 => ns = (1-decay) * batch_stat
+                        bmean = ns["mean"] / (1.0 - decay)
+                        bvar = ns["var"] / (1.0 - decay)
+                        aux = lax.dynamic_update_slice(
+                            aux, lax.stop_gradient(bmean.astype(jnp.float32)),
+                            (off,))
+                        aux = lax.dynamic_update_slice(
+                            aux, lax.stop_gradient(bvar.astype(jnp.float32)),
+                            (self.a_half + off,))
+                    else:
+                        x, _ = layer.apply(p, self._ref.state[s0 + lp], x,
+                                           train=True, rng=lrng)
                 out = x.reshape(x.shape[0], -1).astype(jnp.float32)
                 pad = self.f_max - out.shape[1]
-                return jnp.pad(out, ((0, 0), (0, pad))) if pad else out
+                out = jnp.pad(out, ((0, 0), (0, pad))) if pad else out
+                # zero-valued but structurally REAL dependence on the rng:
+                # branches must all consume the same inputs or lax.switch's
+                # partial-eval produces mismatched residual sets under grad
+                # (stages without dropout would otherwise DCE the key)
+                out = out + 0.0 * jax.random.uniform(
+                    kmicro, (), dtype=out.dtype)
+                return out, aux
 
             return branch
 
         self._branches = [make_branch(i) for i in range(self.n_stages)]
 
     # -- the SPMD pipelined step ------------------------------------------
-    def _stage_apply(self, vec, x, rank):
-        return lax.switch(rank, self._branches, vec, x)
-
-    def _pipelined_forward(self, stacked, x_micro):
-        # Same ring schedule as the low-level kernel (pipeline._gpipe_shard);
-        # only the stage body differs — the rank-switched heterogeneous
-        # branch dispatch.
+    def _pipelined_forward(self, stacked, x_micro, rng):
+        """GPipe ring (the shared ``pipeline._gpipe_shard`` kernel) with a
+        per-(stage, micro) aux channel: at step t each rank applies its
+        stage and also emits its BN layers' batch stats. Returns
+        (outs [M, mb, Fmax], aux [S, M, A_max])."""
         from deeplearning4j_tpu.parallel.pipeline import _gpipe_shard
 
-        fn = functools.partial(
-            _gpipe_shard,
-            stage_apply=lambda vec, x: self._stage_apply(
-                vec, x, lax.axis_index(self.pipe_axis)),
-            axis_name=self.pipe_axis,
-            n_stages=self.n_stages,
-        )
+        branches = self._branches
+        axis_name = self.pipe_axis
+        data_axis = self.data_axis
+        half = self.a_half
+
+        def aux_combine(aux):
+            # Cross-data-shard combine of the [means | local vars] halves,
+            # OUTSIDE the rank switch (collectives inside a data-dependent
+            # branch would not be statically matched across devices). The
+            # parallel-variance form is numerically stable — no
+            # E[x^2]-mean^2 cancellation (shards are equal-sized, so plain
+            # pmeans are exact).
+            mu = aux[:half]
+            var_loc = aux[half:]
+            mu_g = lax.pmean(mu, data_axis)
+            var_g = (lax.pmean(var_loc, data_axis)
+                     + lax.pmean((mu - mu_g) ** 2, data_axis))
+            return jnp.concatenate([mu_g, var_g])
+
+        def shard_fn(params_local, x_mic, rng_):
+            def _pvary(x):
+                try:
+                    return lax.pcast(x, axis_name, to="varying")
+                except ValueError:  # already varying over the pipe axis
+                    return x
+                except (AttributeError, TypeError):  # older jax
+                    return lax.pvary(x, axis_name)
+
+            # Each branch is rematerialized (jax.checkpoint): classic GPipe
+            # per-stage activation recomputation, AND it makes every
+            # branch's autodiff residuals = its inputs — identical avals
+            # across branches, which lax.switch's partial-eval requires
+            # (branches that differ in rng/dropout usage otherwise produce
+            # unequal residual sets with mismatched device-varying types).
+            # Outputs are normalized to pipe-varying for the same reason.
+            rng_v = jax.tree_util.tree_map(_pvary, rng_)
+            wrapped = [
+                jax.checkpoint(lambda v, xx, mm, rr, _b=b: tuple(
+                    _pvary(o) for o in _b(v, xx, mm, rr)))
+                for b in branches
+            ]
+
+            def stage_apply(params, x, micro):
+                idx = lax.axis_index(axis_name)
+                return lax.switch(idx, wrapped, params, x, micro, rng_v)
+
+            return _gpipe_shard(
+                params_local, _pvary(x_mic), stage_apply=stage_apply,
+                axis_name=axis_name, n_stages=self.n_stages,
+                aux_width=self.a_max, aux_combine=aux_combine)
+
         xspec = P(None, self.data_axis)
         return shard_map(
-            fn,
+            shard_fn,
             mesh=self.mesh,
-            in_specs=(P(self.pipe_axis), xspec),
-            out_specs=xspec,
-        )(stacked, x_micro)
+            in_specs=(P(self.pipe_axis), xspec, P()),
+            out_specs=(xspec, P(self.pipe_axis)),
+        )(stacked, x_micro, rng)
 
-    def _loss(self, params, x_micro, y_micro):
+    def _loss(self, params, x_micro, y_micro, rng):
         stacked, head = params
-        outs = self._pipelined_forward(stacked, x_micro)   # [M, mb, Fmax]
+        outs, aux = self._pipelined_forward(stacked, x_micro, rng)
         M, mb = outs.shape[0], outs.shape[1]
         pre = outs[:, :, :self.out_size].reshape(
             (M * mb,) + tuple(self.out_shape)).astype(self._ref.dtype)
@@ -233,18 +402,50 @@ class GPipeTrainer:
             tree = self._unravels[si](stacked[si, :self._stage_lens[si]])
             for layer, p in zip(self._stage_layers[si], tree):
                 total = total + layer.regularization_penalty(p)
-        return total + self.head_cfg.regularization_penalty(head)
+        return total + self.head_cfg.regularization_penalty(head), aux
+
+    def _chain_bn_states(self, bn_state, aux):
+        """EMA-chain each BN layer's running stats over the microbatches in
+        order: s_{m+1} = d*s_m + (1-d)*batch_m (exactly what a
+        single-device microbatched run produces). aux rows are laid out as
+        [all means | all variances] halves (data-axis-aggregated via the
+        stable parallel-variance combine)."""
+        M = aux.shape[1]
+        half = self.a_half
+        new_state = {}
+        for si, bns in enumerate(self._stage_bn):
+            for (_lp, gi, n, decay, off) in bns:
+                mean = bn_state[gi]["mean"]
+                var = bn_state[gi]["var"]
+                for m in range(M):
+                    bm = aux[si, m, off:off + n]
+                    bv = aux[si, m, half + off:half + off + n]
+                    mean = decay * mean + (1.0 - decay) * bm
+                    var = decay * var + (1.0 - decay) * bv
+                new_state[gi] = {"mean": mean, "var": var}
+        return new_state
 
     def make_train_step(self):
         updater = self.updater
+        scale, head_scale = self._update_scales
 
-        def step(params, opt_state, it, x_micro, y_micro):
-            loss, grads = jax.value_and_grad(self._loss)(params, x_micro, y_micro)
+        def step(params, opt_state, bn_state, it, x_micro, y_micro, rng):
+            (loss, aux), grads = jax.value_and_grad(
+                self._loss, has_aux=True)(params, x_micro, y_micro, rng)
             upd, new_opt = updater.update(grads, opt_state, params, it)
-            new_params = jax.tree_util.tree_map(lambda p, d: p - d, params, upd)
-            return new_params, new_opt, loss
+            su, hu = upd
+            # per-position lr scale (per-layer overrides / frozen layers);
+            # exact because every updater here is linear in lr with
+            # internally-consistent state (see module docstring)
+            su = su * scale
+            hu = jax.tree_util.tree_map(lambda d: d * head_scale, hu)
+            stacked, head = params
+            new_params = (stacked - su,
+                          jax.tree_util.tree_map(lambda p, d: p - d, head, hu))
+            new_bn = self._chain_bn_states(bn_state, aux)
+            return new_params, new_opt, new_bn, loss
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        return jax.jit(step, donate_argnums=(0, 1, 2))
 
     # -- training API ------------------------------------------------------
     def fit_batch(self, x, y):
@@ -268,9 +469,11 @@ class GPipeTrainer:
         if pad:
             xm = jnp.pad(xm, ((0, 0), (0, 0), (0, pad)))
         ym = jnp.asarray(y.reshape((self.n_micro, mb) + y.shape[1:]))
-        (self.stacked, self.head_params), self.opt_state, loss = self._step(
-            (self.stacked, self.head_params), self.opt_state,
-            jnp.asarray(self.iteration, jnp.int32), xm, ym)
+        self._rng, k = jax.random.split(self._rng)
+        ((self.stacked, self.head_params), self.opt_state, self.bn_state,
+         loss) = self._step(
+            (self.stacked, self.head_params), self.opt_state, self.bn_state,
+            jnp.asarray(self.iteration, jnp.int32), xm, ym, k)
         self.iteration += 1
         return loss
 
@@ -281,7 +484,7 @@ class GPipeTrainer:
             source = data() if callable(data) else data
             for x, y, fm, lm in _iter_batches(source, batch_size):
                 if fm is not None or lm is not None:
-                    raise NotImplementedError("GPipeTrainer v1: masks unsupported")
+                    raise NotImplementedError("GPipeTrainer v2: masks unsupported")
                 loss = self.fit_batch(x, y)
                 if self.listeners:
                     loss = float(loss)
@@ -313,6 +516,11 @@ class GPipeTrainer:
             lambda a: jnp.asarray(jax.device_get(a), model.dtype),
             self.head_params)
         model.params = tuple(new_params)
+        new_state = list(model.state)
+        for gi, st in self.bn_state.items():
+            new_state[gi] = {k: jnp.asarray(jax.device_get(v), jnp.float32)
+                             for k, v in st.items()}
+        model.state = tuple(new_state)
         model.iteration = self.iteration
         model.epoch = self.epoch
         return model

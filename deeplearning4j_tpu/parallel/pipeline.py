@@ -28,21 +28,32 @@ from jax.sharding import Mesh, PartitionSpec as P
 from deeplearning4j_tpu.parallel.ring import shard_map
 
 
-def _gpipe_shard(params_local, x_micro, *, stage_apply, axis_name, n_stages):
+def _gpipe_shard(params_local, x_micro, *, stage_apply, axis_name, n_stages,
+                 aux_width=None, aux_combine=None):
     """Runs on each pipe rank. params_local: this rank's stage params (leading
     stage axis already stripped to size 1 by shard_map → squeezed here).
     x_micro: [M, mb, ...] microbatched input (replicated across pipe).
-    Returns [M, mb, ...] outputs (valid on the LAST rank, zeros elsewhere)."""
+    ``stage_apply(params, x, micro)`` is one stage's forward for microbatch
+    index ``micro`` (clamped during bubble steps, whose results are
+    discarded); with ``aux_width`` set it returns ``(out, aux[aux_width])``
+    and this function returns ``(outs, auxs [1, M, aux_width])`` — each
+    rank's per-microbatch auxiliary emissions (e.g. BatchNorm batch stats),
+    optionally passed through ``aux_combine`` (e.g. a data-axis pmean).
+    Returns [M, mb, ...] outputs (valid on the LAST rank, zeros elsewhere;
+    psum-broadcast so every rank returns them)."""
     params_local = jax.tree_util.tree_map(lambda a: a[0], params_local)
     idx = lax.axis_index(axis_name)
     M = x_micro.shape[0]
     total = M + n_stages - 1
     perm = [(i, i + 1) for i in range(n_stages - 1)]
+    with_aux = aux_width is not None
 
     def body(t, carry):
-        buf, outs = carry
+        buf, outs, auxs = carry
+        micro = jnp.clip(t - idx, 0, M - 1)
         inp = jnp.where(idx == 0, x_micro[jnp.minimum(t, M - 1)], buf)
-        out = stage_apply(params_local, inp)
+        res = stage_apply(params_local, inp, micro)
+        out, aux = res if with_aux else (res, None)
         shifted = lax.ppermute(out, axis_name, perm)
         # Last rank commits microbatch t-(S-1); earlier (wrapped) writes are
         # overwritten by the later, correct ones.
@@ -50,22 +61,38 @@ def _gpipe_shard(params_local, x_micro, *, stage_apply, axis_name, n_stages):
             outs, jnp.where(idx == n_stages - 1, out, jnp.zeros_like(out)),
             (t - (n_stages - 1)) % M, 0,
         )
-        return shifted, outs
+        if with_aux:
+            if aux_combine is not None:
+                aux = aux_combine(aux)
+            # this rank's aux for micro t-idx is valid iff idx <= t < idx+M;
+            # late bubble steps would otherwise overwrite earlier valid rows
+            # (slot (t-idx) % M wraps)
+            slot = (t - idx) % M
+            valid = jnp.logical_and(t >= idx, t - idx < M)
+            prev = lax.dynamic_index_in_dim(auxs, slot, 0, keepdims=False)
+            auxs = lax.dynamic_update_index_in_dim(
+                auxs, jnp.where(valid, aux, prev), slot, 0)
+        return shifted, outs, auxs
 
     # carries must be typed as device-varying over the pipe axis from the
     # start (they become varying after the first ppermute/update)
     def _pvary(x):
         try:
             return lax.pcast(x, axis_name, to="varying")
+        except ValueError:  # already varying
+            return x
         except (AttributeError, TypeError):  # older jax
             return lax.pvary(x, axis_name)
 
     buf = _pvary(jnp.zeros_like(x_micro[0]))
     outs = _pvary(jnp.zeros_like(x_micro))
-    buf, outs = lax.fori_loop(0, total, body, (buf, outs), unroll=True)
+    auxs = _pvary(jnp.zeros((M, aux_width if with_aux else 1), jnp.float32))
+    buf, outs, auxs = lax.fori_loop(0, total, body, (buf, outs, auxs),
+                                    unroll=True)
     # Only the last rank holds real outputs (zeros elsewhere): psum over the
     # pipe ring broadcasts them so the result is replicated across stages.
-    return lax.psum(outs, axis_name)
+    outs = lax.psum(outs, axis_name)
+    return (outs, auxs[None]) if with_aux else outs
 
 
 class PipelineParallel:
@@ -108,7 +135,7 @@ class PipelineParallel:
         """Pipelined forward; returns [M, mb, ...] outputs (from last stage)."""
         fn = functools.partial(
             _gpipe_shard,
-            stage_apply=self.stage_apply,
+            stage_apply=lambda p, x, _micro: self.stage_apply(p, x),
             axis_name=self.pipe_axis,
             n_stages=self.n_stages,
         )
