@@ -163,15 +163,24 @@ def bench_lenet5():
             st[0], st[1], st[2], _, loss = step(
                 st[0], st[1], st[2], jnp.asarray(i, jnp.int32), rng, x, y,
                 None, None, ())
-        jax.block_until_ready(loss)
+        float(loss)  # value fetch: the only sync the tunnel cannot elide
 
-    dt, steps = _timed(run, warmup_steps=5, steps=50)
-    sps = steps * batch / dt
+    # dispatch-latency-bound microbench: single draws vary with tunnel
+    # jitter, so report the median of k timing loops with the spread
+    reps = []
+    k = 1 if SMOKE else 5
+    for _ in range(k):
+        dt, steps = _timed(run, warmup_steps=5, steps=50)
+        reps.append(steps * batch / dt)
+    reps.sort()
+    sps = reps[len(reps) // 2]
     return {
         "metric": "lenet5_mnist_train_throughput",
         "value": round(sps, 1),
         "unit": "samples/sec",
         "vs_baseline": round(sps / NOMINAL["lenet5_mnist_train_throughput"], 3),
+        "median_of": k,
+        "spread_samples_per_sec": [round(reps[0], 1), round(reps[-1], 1)],
     }
 
 
@@ -208,7 +217,7 @@ def bench_resnet50():
         loss = None
         for _ in range(n):
             loss = cg.fit_batch((x, y))
-        jax.block_until_ready(loss)
+        float(loss)  # value fetch: the only sync the tunnel cannot elide
 
     dt, steps = _timed(run, warmup_steps=3, steps=20)
     ips = steps * batch / dt
@@ -239,7 +248,7 @@ def bench_resnet50():
             loss = None
             for _ in range(n):
                 loss = cg2.fit_batch((x, y))
-            jax.block_until_ready(loss)
+            float(loss)
 
         dt2, steps2 = _timed(run2, warmup_steps=3, steps=20)
         ips2 = steps2 * batch / dt2
@@ -292,7 +301,7 @@ def bench_lstm_char_rnn():
             st[0], st[1], st[2], _, loss = compiled(
                 st[0], st[1], st[2], jnp.asarray(i, jnp.int32), rng, x, y,
                 None, None, ())
-        jax.block_until_ready(loss)
+        float(loss)  # value fetch: the only sync the tunnel cannot elide
 
     dt, steps = _timed(run, warmup_steps=5, steps=50)
     tps = steps * batch * timesteps / dt
@@ -309,20 +318,22 @@ def bench_lstm_char_rnn():
 
 
 def bench_word2vec():
-    """BASELINE #5 — Word2Vec skip-gram negative-sampling update throughput.
+    """BASELINE #5 — Word2Vec: fused-step pairs/sec AND end-to-end corpus
+    tokens/sec (corpus -> vocab -> subsampled pairs -> device steps).
 
-    Drives the jitted _sg_ns_step (the same executable SequenceVectors.fit
-    uses) on synthetic center/context/negative batches: measures the training
-    engine, not the host-side corpus tokenization.
+    ROUND-4 CORRECTION: rounds 1-3 reported ~3B pairs/sec for the fused
+    step. That was a sync artifact (block_until_ready elided through the
+    axon tunnel; a loss-value fetch is the only reliable sync — docs/PERF.md).
+    The honest fused-step rate is ~4-5M pairs/sec, scatter-add bound; the
+    earlier 'dispatch-bound below 16K pairs' batch guidance was derived
+    from the phantom numbers and is superseded by the end-to-end split
+    reported here.
     """
     import jax
     import jax.numpy as jnp
 
     from deeplearning4j_tpu.nlp.embeddings import _sg_ns_step
 
-    # batch 64K: the fused step is dispatch-latency-bound below ~16K pairs
-    # (8K measured 0.1B pairs/sec, 64K measured 3.04B — same executable);
-    # SequenceVectors.batch_size is the user-side lever for the same win
     vocab_size, dim, batch, negative = 100_000, 100, 65536, 5
     if SMOKE:
         vocab_size, batch = 1000, 64
@@ -343,10 +354,60 @@ def bench_word2vec():
         loss = None
         for _ in range(n):
             box[0], loss = step(box[0], centers, contexts, negs, lr)
-        jax.block_until_ready(loss)
+        # ROUND-4 CORRECTION: a loss-VALUE fetch is the only sync the axon
+        # tunnel cannot elide. block_until_ready here let ~50 queued steps
+        # report as done, inflating rounds 1-3 to a phantom 2.95B pairs/sec;
+        # the honest fused-step rate is ~4M pairs/sec (scatter-add bound).
+        float(loss)
 
     dt, steps = _timed(run, warmup_steps=5, steps=50)
     pps = steps * batch / dt
+
+    # ---- END-TO-END: corpus -> vocab -> subsampled pairs -> device steps.
+    # The reference's bottleneck is exactly this host pipeline
+    # (SequenceVectors.java:1021,1127 AsyncSequencer + per-pair threads);
+    # here the host side is the vectorized numpy pair backend and device
+    # dispatch is async, so pair-gen for batch k+1 overlaps the device
+    # executing batch k (JAX's dispatch queue IS the double buffer).
+    import time as _time
+
+    from deeplearning4j_tpu.nlp.embeddings import (
+        Word2Vec, _fast_pairs, subsample_probs)
+
+    n_tokens, v_eff, sent_len = 2_000_000, 50_000, 1000
+    if SMOKE:
+        n_tokens, v_eff, sent_len = 20_000, 500, 100
+    zipf = rs.zipf(1.3, n_tokens * 2)
+    toks = zipf[zipf <= v_eff][:n_tokens].astype(np.int64)
+    corpus = [[f"w{t}" for t in toks[i:i + sent_len]]
+              for i in range(0, len(toks), sent_len)]
+
+    m = Word2Vec(layer_size=dim, window=5, negative=negative,
+                 min_word_frequency=1, epochs=1, seed=1,
+                 batch_size=65536, pair_backend="numpy")
+    t0 = _time.perf_counter()
+    m.build_vocab(corpus)
+    t_vocab = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    m.fit(corpus)          # cold: includes XLA compiles of scan + tail
+    jax.block_until_ready(m.params["syn0"])
+    t_fit_cold = _time.perf_counter() - t0
+    idx_seqs = m._index_sequences(corpus)
+    t0 = _time.perf_counter()
+    m._run_epochs(idx_seqs, 1)   # warm steady-state epoch (the number that
+    jax.block_until_ready(m.params["syn0"])  # amortizes over real training)
+    t_epoch_warm = _time.perf_counter() - t0
+    e2e_tps_cold = n_tokens / (t_vocab + t_fit_cold)
+    e2e_tps = n_tokens / (t_vocab / 2 + t_epoch_warm)  # vocab amortized over 2 epochs
+
+    # host-only pair generation (same generator, no device steps) to
+    # quantify the host/device split
+    keep = subsample_probs(m.vocab, m.sample)
+    t0 = _time.perf_counter()
+    n_pairs = sum(len(c) for c, _t in _fast_pairs(
+        idx_seqs, m.window, keep, np.random.RandomState(1)))
+    t_host = _time.perf_counter() - t0
+
     return {
         "metric": "word2vec_skipgram_throughput",
         "value": round(pps, 1),
@@ -354,6 +415,16 @@ def bench_word2vec():
         "vs_baseline": round(pps / NOMINAL["word2vec_skipgram_throughput"], 3),
         "vocab": vocab_size,
         "dim": dim,
+        "end_to_end_tokens_per_sec": round(e2e_tps, 1),
+        "end_to_end_tokens_per_sec_cold": round(e2e_tps_cold, 1),
+        "end_to_end_corpus_tokens": n_tokens,
+        "end_to_end_split_sec": {
+            "vocab_build": round(t_vocab, 3),
+            "first_epoch_incl_compile": round(t_fit_cold, 3),
+            "warm_epoch": round(t_epoch_warm, 3),
+            "host_pairgen_alone": round(t_host, 3),
+        },
+        "host_pairgen_pairs_per_sec": round(n_pairs / max(t_host, 1e-9), 1),
     }
 
 

@@ -71,6 +71,36 @@ def _sg_ns_step(params, centers, contexts, negs, lr):
                                               if k not in ("syn0", "syn1neg")}}, loss
 
 
+def _sg_ns_epoch_scan(params, centers2d, contexts2d, cum_table, key,
+                      lr0, min_lr, seen0, total, negative: int):
+    """lax.scan of _sg_ns_step over [N, B] pair chunks, negatives drawn
+    ON-DEVICE by inverse-CDF over the unigram table. One dispatch (and ONE
+    host->device transfer of the pair arrays) covers N batches — through a
+    remote/tunneled device this removes the per-batch RTT that otherwise
+    dominates end-to-end corpus training (docs/PERF.md Word2Vec)."""
+    N, B = centers2d.shape
+
+    def body(carry, xs):
+        prm, k, seen = carry
+        c, t = xs
+        k, sub = jax.random.split(k)
+        u = jax.random.uniform(sub, (B, negative))
+        negs = jnp.clip(jnp.searchsorted(cum_table, u),
+                        0, cum_table.shape[0] - 1).astype(jnp.int32)
+        frac = jnp.minimum(seen / total, 1.0)
+        lr = jnp.maximum(lr0 * (1.0 - frac), min_lr)
+        prm, loss = _sg_ns_step(prm, c, t, negs, lr)
+        return (prm, k, seen + B), loss
+
+    # unroll=4: scan-of-scatter on TPU runs ~4x faster partially unrolled
+    # (measured 283 -> 64 ms/step at B=64K, V=100K; unroll=16 is no better
+    # and triples compile time)
+    (params, _, _), losses = jax.lax.scan(
+        body, (params, key, jnp.asarray(seen0, jnp.float32)),
+        (centers2d, contexts2d), unroll=4)
+    return params, losses
+
+
 def _cbow_ns_step(params, context_win, win_mask, targets, negs, lr):
     """CBOW negative sampling: mean of window vectors predicts the target.
 
@@ -253,6 +283,58 @@ def _batched(gen, batch_size: int):
         yield np.asarray(buf_c, np.int32), np.asarray(buf_t, np.int32)
 
 
+def _fast_pairs(idx_seqs, window: int, keep: np.ndarray,
+                rs: np.random.RandomState):
+    """Vectorized skip-gram pair generation: per sentence, same
+    subsampling + dynamic-window SEMANTICS as _PairGenerator.generate (a
+    pair (i, i±o) exists iff o <= b_i and in range) but built with per-
+    offset numpy masks instead of a per-pair Python loop — ~50x the
+    host-side throughput (docs/PERF.md Word2Vec end-to-end). Draw ORDER
+    differs from the per-pair generator, so trajectories are not
+    bit-identical across backends (the pair multiset per sentence is,
+    given equal rng draws). Yields (centers, contexts) int32 arrays."""
+    for idx in idx_seqs:
+        if len(idx) < 2:
+            continue
+        kmask = rs.rand(len(idx)) < keep[idx]
+        idx = idx[kmask]
+        n = len(idx)
+        if n < 2:
+            continue
+        b = rs.randint(1, window + 1, n)
+        pos = np.arange(n)
+        cs, ts = [], []
+        for o in range(1, window + 1):
+            sel = b >= o
+            right = pos[sel & (pos + o < n)]
+            left = pos[sel & (pos - o >= 0)]
+            cs.append(idx[right])
+            ts.append(idx[right + o])
+            cs.append(idx[left])
+            ts.append(idx[left - o])
+        yield (np.concatenate(cs).astype(np.int32),
+               np.concatenate(ts).astype(np.int32))
+
+
+def _batched_arrays(gen, batch_size: int):
+    """Re-chunk a stream of (centers, contexts) ARRAYS into batch_size
+    pieces (array analogue of _batched)."""
+    bufs_c, bufs_t, count = [], [], 0
+    for c, t in gen:
+        bufs_c.append(c)
+        bufs_t.append(t)
+        count += len(c)
+        if count >= batch_size:
+            cc = np.concatenate(bufs_c)
+            tt = np.concatenate(bufs_t)
+            while len(cc) >= batch_size:
+                yield cc[:batch_size], tt[:batch_size]
+                cc, tt = cc[batch_size:], tt[batch_size:]
+            bufs_c, bufs_t, count = [cc], [tt], len(cc)
+    if count:
+        yield np.concatenate(bufs_c), np.concatenate(bufs_t)
+
+
 def _batched_windows(gen, batch_size: int, max_width: int):
     """Batch (center, [contexts]) — or tagged (tag, center, [contexts]) —
     into padded [B,W] arrays + win_mask. Tagged items (the PV-DM doc id)
@@ -310,13 +392,20 @@ class SequenceVectors:
         min_word_frequency: int = 5,
         sample: float = 1e-3,
         epochs: int = 1,
-        # pairs per fused device step. The step is dispatch-latency-bound
-        # below ~16K pairs (docs/PERF.md); small corpora produce smaller
-        # final batches anyway, so a large default only helps. Raise toward
-        # 65536 for maximum throughput on big corpora.
+        # pairs per fused device step; the step is scatter-add bound at
+        # large batches (docs/PERF.md round-4 correction). Raise toward
+        # 65536 on big corpora to amortize dispatch.
         batch_size: int = 8192,
         elements_learning: str = "skipgram",
         seed: int = 12345,
+        # "python": per-pair generator (reference-faithful draw order);
+        # "numpy": vectorized per-offset masks, ~50x host throughput —
+        # same pair distribution, different rng draw order (skip-gram only).
+        # With "numpy", SG-NS training also runs scan_batches device steps
+        # per dispatch (negatives drawn on-device, inverse-CDF over the
+        # unigram table — same distribution as the host draw).
+        pair_backend: str = "python",
+        scan_batches: int = 64,
     ):
         self.layer_size = layer_size
         self.window = window
@@ -329,6 +418,12 @@ class SequenceVectors:
         self.epochs = epochs
         self.batch_size = batch_size
         self.elements_learning = elements_learning
+        if pair_backend not in ("python", "numpy"):
+            raise ValueError(f"pair_backend must be 'python' or 'numpy', got {pair_backend!r}")
+        if scan_batches < 1:
+            raise ValueError(f"scan_batches must be >= 1, got {scan_batches}")
+        self.pair_backend = pair_backend
+        self.scan_batches = scan_batches
         self.seed = seed
         self.vocab: Optional[VocabCache] = None
         self.params: Optional[dict] = None
@@ -396,6 +491,7 @@ class SequenceVectors:
             codes_j, points_j = jnp.asarray(codes), jnp.asarray(points)
             hmask_j = jnp.asarray(hmask)
 
+        cum_dev = None  # unigram-table cumsum, uploaded once for all epochs
         span = schedule_span if schedule_span is not None else epochs
         pairs_per_epoch = sum(len(s) for s in idx_seqs) * self.window
         total_pairs_est = max(pairs_per_epoch * span, 1)
@@ -429,7 +525,54 @@ class SequenceVectors:
                             jnp.asarray(lr, jnp.float32),
                         )
                 continue
-            for centers, contexts in _batched(pg.generate(idx_seqs), self.batch_size):
+            if self.pair_backend == "numpy" and not self.use_hs:
+                # epoch-scan fast path: chunks of scan_batches full batches
+                # run as ONE device dispatch (lax.scan, on-device negatives)
+                # — the leftover tail falls through to the per-batch path
+                chunk = self.batch_size * self.scan_batches
+                if "sg_ns_scan" not in self._step_cache:
+                    self._step_cache["sg_ns_scan"] = jax.jit(
+                        _sg_ns_epoch_scan, donate_argnums=(0,),
+                        static_argnames=("negative",))
+                scan_step = self._step_cache["sg_ns_scan"]
+                if cum_dev is None:
+                    cum_dev = jnp.asarray(np.cumsum(table), jnp.float32)
+                cum = cum_dev
+                # separate key stream: drawing chunk keys from self._rs
+                # would interleave with the (lazy) pair generator's draws
+                # and break pair-stream reproducibility
+                key_rs = np.random.RandomState(self._rs.randint(2 ** 31))
+                tail_c: List[np.ndarray] = []
+                tail_t: List[np.ndarray] = []
+                for cc, tt in _batched_arrays(
+                        _fast_pairs(idx_seqs, self.window, keep, self._rs),
+                        chunk):
+                    if len(cc) == chunk:
+                        key = jax.random.PRNGKey(key_rs.randint(2 ** 31))
+                        self.params, _ = scan_step(
+                            self.params,
+                            jnp.asarray(cc.reshape(self.scan_batches,
+                                                   self.batch_size)),
+                            jnp.asarray(tt.reshape(self.scan_batches,
+                                                   self.batch_size)),
+                            cum, key, jnp.asarray(self.lr, jnp.float32),
+                            jnp.asarray(self.min_lr, jnp.float32),
+                            float(seen), float(total_pairs_est),
+                            negative=self.negative)
+                        seen += len(cc)
+                    else:
+                        tail_c.append(cc)
+                        tail_t.append(tt)
+                # tail: re-chunk to batch_size for the per-batch path
+                pair_stream = _batched_arrays(zip(tail_c, tail_t),
+                                              self.batch_size)
+            elif self.pair_backend == "numpy":
+                pair_stream = _batched_arrays(
+                    _fast_pairs(idx_seqs, self.window, keep, self._rs),
+                    self.batch_size)
+            else:
+                pair_stream = _batched(pg.generate(idx_seqs), self.batch_size)
+            for centers, contexts in pair_stream:
                 frac = min(seen / total_pairs_est, 1.0)
                 lr = max(self.lr * (1.0 - frac), self.min_lr)
                 seen += len(centers)
@@ -449,7 +592,16 @@ class SequenceVectors:
                     )
 
     def _draw_negatives(self, table: np.ndarray, shape) -> np.ndarray:
-        return self._rs.choice(len(table), size=shape, p=table).astype(np.int32)
+        # inverse-CDF sampling: identical distribution to
+        # rs.choice(p=table) but ~100x faster at vocab 100K (choice-with-p
+        # rebuilds its alias structures per call); cumsum cached per table
+        cached = getattr(self, "_neg_cum", None)
+        if cached is None or cached[0] is not table:
+            cached = (table, np.cumsum(table))
+            self._neg_cum = cached
+        u = self._rs.random_sample(shape)
+        return np.minimum(np.searchsorted(cached[1], u),
+                          len(table) - 1).astype(np.int32)
 
     # -- lookup API (WordVectors interface) --------------------------------
     @property
