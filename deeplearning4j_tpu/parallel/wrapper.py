@@ -27,6 +27,19 @@ from deeplearning4j_tpu.nn.model import _iter_batches
 from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
 
 
+def _tile_pad(a, pad: int):
+    """Append ``pad`` rows to ``a`` by tiling its real rows (zero rows when
+    the array is empty — a host contributing 0 examples still ships
+    correctly-shaped, zero-weighted shards)."""
+    if a is None:
+        return None
+    a = np.asarray(a)
+    if len(a) == 0:
+        return np.zeros((pad,) + a.shape[1:], a.dtype)
+    reps = np.concatenate([a] * (pad // len(a) + 1))[:pad]
+    return np.concatenate([a, reps])
+
+
 class ParallelWrapper:
     """Drop-in accelerator for a MultiLayerNetwork/ComputationGraph: same
     ``fit`` surface, batch sharded over the mesh's ``data`` axis.
@@ -47,9 +60,12 @@ class ParallelWrapper:
         self._repl = NamedSharding(self.mesh, P())
         # Multi-host (jax.distributed): every process runs this same fit()
         # on its process-LOCAL batch rows; global batch = concat over
-        # processes in process order. Local batches must be the same size on
-        # every host (the padding/loss-rescale math assumes it). Padding
-        # granularity is the per-process shard count.
+        # processes in process order. Per-host batch sizes may be UNEVEN
+        # (MLN path): hosts equalize padded sizes via process_allgather and
+        # the loss rescale uses the GLOBAL real-row count, so the result
+        # equals a single-process run on the concatenated batch exactly
+        # (tests/test_multihost.py). Padding granularity is the per-process
+        # shard count.
         self._nproc = jax.process_count()
         self._pad_quantum = max(self.n_data // self._nproc, 1)
 
@@ -78,20 +94,36 @@ class ParallelWrapper:
         ``_padded_lmask`` — or they would silently double-weight samples in
         the gradient."""
         n = next(len(a) for a in arrs if a is not None)
-        if n % self._pad_quantum == 0:
+        if n % self._pad_quantum == 0 and n > 0:
             return arrs, n
-        pad = self._pad_quantum - n % self._pad_quantum
+        pad = (self._pad_quantum - n % self._pad_quantum) % self._pad_quantum
+        if n == 0:
+            pad = self._pad_quantum
+        return tuple(_tile_pad(a, pad) for a in arrs), n
 
-        def _pad(a):
-            if a is None:
-                return None
-            a = np.asarray(a)
-            reps = np.concatenate([a] * (pad // n + 1))[:pad]
-            return np.concatenate([a, reps])
+    def _even_multihost(self, arrs, n):
+        """Equalize each process's PADDED local row count to the global max
+        (global_array needs equal per-process shards) and return the global
+        real-row count + global padded batch size.
 
-        return tuple(_pad(a) for a in arrs), n
+        The allgather runs EVERY batch on purpose: it is a collective, and
+        skip-when-locally-unchanged caching would deadlock the moment one
+        host's batch size changes while another's repeats (each host can
+        only see its own key). It moves 16 bytes; the per-batch cost is a
+        host-side round-trip, negligible next to the training step."""
+        from jax.experimental import multihost_utils
 
-    def _padded_lmask(self, y, lm, n):
+        local = next(len(a) for a in arrs if a is not None)
+        info = multihost_utils.process_allgather(
+            np.asarray([n, local], np.int64))
+        info = np.asarray(info).reshape(self._nproc, 2)
+        n_tot = int(info[:, 0].sum())
+        target = int(info[:, 1].max())
+        if local < target:
+            arrs = tuple(_tile_pad(a, target - local) for a in arrs)
+        return arrs, n_tot, target * self._nproc
+
+    def _padded_lmask(self, y, lm, n, scale=None):
         """Label mask zero-weighting padded rows [n:] so the jitted step's
         loss averages over the n REAL examples only (exact equivalence with
         the unpadded single-device fit).
@@ -113,10 +145,10 @@ class ParallelWrapper:
         denominator B_pad·H·W needs the same B_pad/n correction)."""
         y = np.asarray(y)
         total = len(y)
-        if total == n and lm is None:
+        if scale is None and total == n and lm is None:
             return lm
         valid = np.zeros(total, np.float32)
-        valid[:n] = float(total) / float(n)
+        valid[:n] = float(total) / float(n) if scale is None else float(scale)
         if lm is not None:
             lm = np.asarray(lm, np.float32)
             return lm * valid.reshape([total] + [1] * (lm.ndim - 1))
@@ -145,9 +177,20 @@ class ParallelWrapper:
                 # then zero-weight the padded rows in the loss; ew excludes
                 # them from batch-coupled statistics (BatchNorm)
                 (x, y, fm, lm), n = self._pad_to_shardable(batch)
-                lm = self._padded_lmask(y, lm, n)
+                if self._nproc > 1:
+                    (x, y, fm, lm), n_tot, gB = self._even_multihost(
+                        (x, y, fm, lm), n)
+                    # global rescale: every real row weighs gB/n_tot so the
+                    # loss equals the single-process mean over n_tot rows
+                    # even when hosts contribute different row counts
+                    lm = (self._padded_lmask(y, lm, n, scale=gB / n_tot)
+                          if n_tot != gB or lm is not None else lm)
+                    padded = n_tot != gB
+                else:
+                    lm = self._padded_lmask(y, lm, n)
+                    padded = len(x) != n
                 ew = None
-                if len(x) != n:
+                if padded:
                     ew = np.zeros(len(x), np.float32)
                     ew[:n] = 1.0
                 score = model._fit_batch(
@@ -180,17 +223,39 @@ class ParallelWrapper:
                     fm, _ = self._pad_to_shardable(fm)
                 if lm is not None:
                     lm, _ = self._pad_to_shardable(lm)
-                if lbl is not None:
+                scale = None
+                if self._nproc > 1:
+                    # equalize padded sizes + global loss rescale, jointly
+                    # over every MultiDataSet member (same mechanism as the
+                    # MLN path — uneven per-host batches stay exact)
+                    lens = [len(t) if t is not None else 0
+                            for t in (f, lbl, fm, lm)]
+                    flat = sum((list(t) for t in (f, lbl, fm, lm)
+                                if t is not None), [])
+                    flat, n_tot, gB = self._even_multihost(tuple(flat), n)
+                    flat = list(flat)
+                    parts = []
+                    for ln, t in zip(lens, (f, lbl, fm, lm)):
+                        parts.append(tuple(flat[:ln]) if t is not None else None)
+                        flat = flat[ln:]
+                    f, lbl, fm, lm = parts
+                    if n_tot != gB:
+                        scale = gB / n_tot
+                    padded = n_tot != gB
+                else:
+                    padded = len(f[0]) != n
+                if lbl is not None and (padded or lm is not None):
                     # zero-weight padded rows in every output's loss
                     lms = lm if lm is not None else (None,) * len(lbl)
                     lm = tuple(
-                        self._padded_lmask(yi, lmi, n) for yi, lmi in zip(lbl, lms)
+                        self._padded_lmask(yi, lmi, n, scale=scale)
+                        for yi, lmi in zip(lbl, lms)
                     )
                     if all(m is None for m in lm):
                         lm = None
                 ew = None
                 total = len(f[0])
-                if total != n:
+                if padded:
                     # exclude padded rows from batch-coupled statistics
                     # (BatchNorm vertices) — same channel as the MLN path
                     ew = np.zeros(total, np.float32)

@@ -1,5 +1,13 @@
 """Subprocess worker for tests/test_multihost.py: one training process in a
-2-process CPU cluster (4 virtual devices each -> 8-device global mesh)."""
+2-process CPU cluster (4 virtual devices each -> 8-device global mesh).
+
+Three scenarios per run (the round-4 hardening of SURVEY §2.5 coverage):
+  1. dense MLP, even per-host batches      (the original mechanism proof)
+  2. conv+BN net, UNEVEN per-host batches  (host0: 10 rows, host1: 6) —
+     exactness relies on the allgather-equalized padding + global loss
+     rescale in ParallelWrapper and ex_weight-excluded BN statistics
+  3. multi-host x tensor-parallel smoke    (data=4 x model=2 mesh)
+"""
 
 import json
 import os
@@ -28,11 +36,15 @@ def main():
     assert len(jax.devices()) == 4 * nproc, f"global devices {len(jax.devices())}"
 
     from deeplearning4j_tpu.nn.input_type import InputType
-    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.layers import (
+        BatchNorm, Conv2D, Dense, OutputLayer)
     from deeplearning4j_tpu.nn.model import MultiLayerConfiguration, MultiLayerNetwork
     from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
     from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 
+    results = {}
+
+    # ---- scenario 1: dense MLP, even per-host batches -------------------
     conf = MultiLayerConfiguration(
         layers=(Dense(n_out=16, activation="relu"),
                 Dense(n_out=8, activation="tanh"),
@@ -42,7 +54,6 @@ def main():
         seed=77,  # same seed on every process -> identical init
     )
     model = MultiLayerNetwork(conf).init()
-
     rs = np.random.RandomState(123)          # same global data everywhere
     xg = rs.rand(16, 10).astype(np.float32)
     yg = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 16)]
@@ -50,14 +61,98 @@ def main():
 
     pw = ParallelWrapper(model, make_mesh(MeshSpec(data=8)))
     pw.fit((xg[lo:hi], yg[lo:hi]), epochs=3)
-
     if idx == 0:
         leaves = [np.asarray(jax.device_get(l))
                   for l in jax.tree_util.tree_leaves(model.params)]
         np.savez(os.path.join(outdir, "mh_params.npz"),
                  **{str(i): l for i, l in enumerate(leaves)})
+
+    # ---- scenario 2: conv+BN, UNEVEN per-host batches -------------------
+    def bn_conf():
+        return MultiLayerConfiguration(
+            layers=(Conv2D(n_out=4, kernel=(3, 3), convolution_mode="same",
+                           activation="identity", has_bias=False),
+                    BatchNorm(),
+                    Dense(n_out=8, activation="relu"),
+                    OutputLayer(n_out=3, activation="softmax")),
+            input_type=InputType.convolutional(6, 6, 1),
+            updater={"type": "adam", "lr": 5e-3},
+            seed=31,
+        )
+
+    model2 = MultiLayerNetwork(bn_conf()).init()
+    rs2 = np.random.RandomState(7)
+    xg2 = rs2.rand(16, 6, 6, 1).astype(np.float32)
+    yg2 = np.eye(3, dtype=np.float32)[rs2.randint(0, 3, 16)]
+    cut = 10                                  # host0: 10 rows, host1: 6
+    sl = slice(0, cut) if idx == 0 else slice(cut, 16)
+    pw2 = ParallelWrapper(model2, make_mesh(MeshSpec(data=8)))
+    pw2.fit((xg2[sl], yg2[sl]), epochs=3)
+    if idx == 0:
+        leaves = [np.asarray(jax.device_get(l))
+                  for l in jax.tree_util.tree_leaves(model2.params)]
+        np.savez(os.path.join(outdir, "mh_bn_params.npz"),
+                 **{str(i): l for i, l in enumerate(leaves)})
+        st = [np.asarray(jax.device_get(l))
+              for l in jax.tree_util.tree_leaves(model2.state)]
+        np.savez(os.path.join(outdir, "mh_bn_state.npz"),
+                 **{str(i): l for i, l in enumerate(st)})
+
+    # ---- scenario 2b: ComputationGraph conv+BN, UNEVEN per-host batches -
+    from deeplearning4j_tpu.nn.graph import ComputationGraph, ComputationGraphConfiguration
+
+    def cg_conf():
+        g = (ComputationGraphConfiguration.builder()
+             .add_inputs("in")
+             .set_input_types(InputType.convolutional(6, 6, 1)))
+        g.add_layer("c1", Conv2D(n_out=4, kernel=(3, 3),
+                                 convolution_mode="same",
+                                 activation="identity", has_bias=False), "in")
+        g.add_layer("bn", BatchNorm(), "c1")
+        g.add_layer("out", OutputLayer(n_out=3, activation="softmax"), "bn")
+        g.set_outputs("out")
+        g.updater({"type": "adam", "lr": 5e-3})
+        conf = g.build()
+        conf.seed = 13
+        return conf
+
+    cg = ComputationGraph(cg_conf()).init()
+    rsg = np.random.RandomState(11)
+    xgc = rsg.rand(16, 6, 6, 1).astype(np.float32)
+    ygc = np.eye(3, dtype=np.float32)[rsg.randint(0, 3, 16)]
+    slg = slice(0, 10) if idx == 0 else slice(10, 16)
+    pwg = ParallelWrapper(cg, make_mesh(MeshSpec(data=8)))
+    pwg.fit((xgc[slg], ygc[slg]), epochs=2)
+    if idx == 0:
+        leaves = [np.asarray(jax.device_get(l))
+                  for l in jax.tree_util.tree_leaves(cg.params)]
+        np.savez(os.path.join(outdir, "mh_cg_params.npz"),
+                 **{str(i): l for i, l in enumerate(leaves)})
+
+    # ---- scenario 3: multi-host x tensor-parallel smoke -----------------
+    from deeplearning4j_tpu.models import TransformerLM
+    from deeplearning4j_tpu.parallel import ShardedTrainer
+
+    mesh_tp = make_mesh(MeshSpec(data=4, model=2))
+    conf_tp = TransformerLM(vocab_size=32, max_len=16, d_model=32, n_heads=2,
+                            n_blocks=1, dtype="float32")
+    model3 = MultiLayerNetwork(conf_tp).init()
+    tr = ShardedTrainer(model3, mesh_tp)
+    rs3 = np.random.RandomState(5)
+    # every host feeds the identical GLOBAL batch; device_put materializes
+    # each host's addressable shards of it
+    xg3 = rs3.randint(0, 32, (8, 16))
+    yg3 = np.eye(32, dtype=np.float32)[rs3.randint(0, 32, (8, 16))]
+    l1 = float(tr.fit_batch(xg3, yg3))
+    l2 = float(tr.fit_batch(xg3, yg3))
+    assert np.isfinite(l1) and np.isfinite(l2), (l1, l2)
+    results["tp_losses"] = [l1, l2]
+
+    if idx == 0:
+        results["processes"] = nproc
+        results["devices"] = len(jax.devices())
         with open(os.path.join(outdir, "mh_done.json"), "w") as f:
-            json.dump({"processes": nproc, "devices": len(jax.devices())}, f)
+            json.dump(results, f)
 
 
 if __name__ == "__main__":
