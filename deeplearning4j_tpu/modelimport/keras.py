@@ -138,6 +138,10 @@ def _keras1_normalize(class_name: str, cfg: dict) -> dict:
         cfg.setdefault("pool_size", cfg["pool_length"])
     if "stride" in cfg and "strides" not in cfg:
         cfg.setdefault("strides", cfg["stride"])
+    if "atrous_rate" in cfg:
+        # Keras-1 AtrousConvolution1D/2D (reference
+        # KerasAtrousConvolution1D/2D.java): dilation under a legacy name
+        cfg.setdefault("dilation_rate", cfg["atrous_rate"])
     if class_name in ("Dropout", "GaussianDropout", "AlphaDropout") and "p" in cfg:
         cfg.setdefault("rate", cfg["p"])
     if class_name == "GaussianNoise" and "sigma" in cfg:
@@ -162,7 +166,7 @@ def _convert_layer(class_name: str, cfg: dict, *, as_output: bool = False,
             )
         return Dense(n_out=int(cfg["units"]), activation=act,
                      has_bias=bool(cfg.get("use_bias", True)))
-    if class_name in ("Conv2D", "Convolution2D"):
+    if class_name in ("Conv2D", "Convolution2D", "AtrousConvolution2D"):
         mode, pad = _conv_mode(cfg.get("padding", "valid"))
         return Conv2D(
             n_out=int(cfg["filters"]), kernel=_pair(cfg.get("kernel_size", 3)),
@@ -171,14 +175,16 @@ def _convert_layer(class_name: str, cfg: dict, *, as_output: bool = False,
             activation=_act(cfg.get("activation")),
             has_bias=bool(cfg.get("use_bias", True)),
         )
-    if class_name in ("Conv1D", "Convolution1D"):
+    if class_name in ("Conv1D", "Convolution1D", "AtrousConvolution1D"):
         mode, _ = _conv_mode(cfg.get("padding", "valid"))
         k = cfg.get("kernel_size", 3)
         s = cfg.get("strides", 1)
+        d = cfg.get("dilation_rate", 1)
         return Conv1D(
             n_out=int(cfg["filters"]),
             kernel=int(k[0] if isinstance(k, (list, tuple)) else k),
             stride=int(s[0] if isinstance(s, (list, tuple)) else s),
+            dilation=int(d[0] if isinstance(d, (list, tuple)) else d),
             convolution_mode=mode, activation=_act(cfg.get("activation")),
             has_bias=bool(cfg.get("use_bias", True)),
         )

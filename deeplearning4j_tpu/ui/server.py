@@ -96,6 +96,25 @@ td, th { border: 1px solid #ccc; padding: 3px 8px; }
 """
 
 
+def _scatter_svg(coords, labels=None, w: int = 640, h: int = 480) -> str:
+    """Inline-SVG scatter of a 2-D embedding (+ optional point labels)."""
+    xs, ys = coords[:, 0], coords[:, 1]
+    x0, x1 = float(xs.min()), float(xs.max())
+    y0, y1 = float(ys.min()), float(ys.max())
+    sx = (w - 40) / ((x1 - x0) or 1.0)
+    sy = (h - 40) / ((y1 - y0) or 1.0)
+    pts = []
+    for i in range(len(coords)):
+        px = 20 + (float(xs[i]) - x0) * sx
+        py = h - 20 - (float(ys[i]) - y0) * sy
+        pts.append(f"<circle cx='{px:.1f}' cy='{py:.1f}' r='3' fill='#1f77b4'/>")
+        if labels is not None:
+            pts.append(f"<text x='{px + 4:.1f}' y='{py - 4:.1f}' "
+                       f"font-size='9'>{html.escape(labels[i])}</text>")
+    return (f"<svg width='{w}' height='{h}' style='border:1px solid #ccc'>"
+            + "".join(pts) + "</svg>")
+
+
 class UIServer:
     """``UIServer.get_instance().attach(storage)`` then ``render(path)`` or
     ``serve(port)``."""
@@ -108,6 +127,9 @@ class UIServer:
         self._thread = None
         self.port: Optional[int] = None
         self._remote_storage: Optional[StatsStorage] = None
+        # /tsne embedding page (reference deeplearning4j-play
+        # module/tsne/TsneModule.java): named 2-D point sets + labels
+        self._tsne_sets: dict = {}
 
     @classmethod
     def get_instance(cls) -> "UIServer":
@@ -205,6 +227,38 @@ class UIServer:
             f.write(self.render_html())
         return path
 
+    # -- t-SNE embedding page (TsneModule parity) --------------------------
+    def upload_tsne(self, coords, labels=None, session_id: str = "tsne"):
+        """Register a 2-D embedding for the ``/tsne`` page (the reference
+        TsneModule's file-upload flow, as a programmatic surface — e.g.
+        ``upload_tsne(BarnesHutTsne(...).fit_transform(X), words)``)."""
+        import numpy as np
+
+        coords = np.asarray(coords, float)
+        if coords.ndim != 2 or coords.shape[1] < 2:
+            raise ValueError(f"coords must be [n, 2+], got {coords.shape}")
+        if labels is not None and len(labels) != len(coords):
+            raise ValueError("labels length must match coords")
+        self._tsne_sets[session_id] = (
+            coords[:, :2],
+            [str(l) for l in labels] if labels is not None else None,
+        )
+        return self
+
+    def render_tsne_html(self) -> str:
+        parts = [f"<html><head><meta charset='utf-8'><style>{_CSS}</style>"
+                 "<title>t-SNE embeddings</title></head><body>"
+                 "<h1>t-SNE embeddings</h1>"]
+        if not self._tsne_sets:
+            parts.append("<p>No embeddings uploaded — POST JSON "
+                         "{\"coords\": [[x,y]...], \"labels\": [...]} to "
+                         "/tsne, or call UIServer.upload_tsne().</p>")
+        for sid, (coords, labels) in sorted(self._tsne_sets.items()):
+            parts.append(f"<h2>{html.escape(sid)} ({len(coords)} points)</h2>")
+            parts.append(_scatter_svg(coords, labels))
+        parts.append("</body></html>")
+        return "".join(parts)
+
     # -- serving -----------------------------------------------------------
     def serve(self, port: int = 9001) -> "UIServer":
         outer = self
@@ -218,6 +272,9 @@ class UIServer:
                     # served pages are live: re-rendered per request + a
                     # 5s meta-refresh so the browser polls while training
                     body = outer.render_html(refresh_seconds=5).encode()
+                    ctype = "text/html"
+                elif self.path == "/tsne":
+                    body = outer.render_tsne_html().encode()
                     ctype = "text/html"
                 elif self.path == "/stats":
                     body = json.dumps([
@@ -235,6 +292,25 @@ class UIServer:
                 self.wfile.write(body)
 
             def do_POST(self):
+                if self.path == "/tsne":
+                    # TsneModule upload parity: JSON {coords, labels?, name?}
+                    try:
+                        n = int(self.headers.get("Content-Length", "0"))
+                        payload = json.loads(self.rfile.read(n).decode("utf-8"))
+                        outer.upload_tsne(payload["coords"],
+                                          payload.get("labels"),
+                                          session_id=str(payload.get("name",
+                                                                     "tsne")))
+                    except Exception as e:
+                        self.send_response(400)
+                        self.end_headers()
+                        self.wfile.write(str(e).encode())
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Length", "2")
+                    self.end_headers()
+                    self.wfile.write(b"ok")
+                    return
                 if self.path != "/remote" or outer._remote_storage is None:
                     self.send_response(404)
                     self.end_headers()

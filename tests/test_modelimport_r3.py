@@ -288,3 +288,59 @@ class TestShapeOpStragglers:
         }
         conf, _ = _sequential_from_config(cfgjson)
         assert any(isinstance(l, MaskZero) for l in conf.layers)
+
+
+class TestKeras1Atrous:
+    """Keras-1 AtrousConvolution1D/2D (reference KerasAtrousConvolution1D/
+    2D.java): dilated convs under the legacy class names + atrous_rate."""
+
+    def test_atrous_conv2d_maps_to_dilated_conv(self):
+        conf = KerasModelImport.import_keras_sequential_configuration(json.dumps({
+            "class_name": "Sequential",
+            "config": [
+                {"class_name": "AtrousConvolution2D", "config": {
+                    "batch_input_shape": [None, 12, 12, 1],
+                    "nb_filter": 3, "nb_row": 3, "nb_col": 3,
+                    "atrous_rate": [2, 2], "subsample": [1, 1],
+                    "border_mode": "valid", "activation": "relu",
+                    "name": "aconv"}},
+                {"class_name": "Flatten", "config": {"name": "flat"}},
+                {"class_name": "Dense", "config": {
+                    "output_dim": 4, "activation": "softmax", "name": "out"}},
+            ],
+        }))
+        from deeplearning4j_tpu.nn.layers import Conv2D
+        conv = next(l for l in conf.layers if isinstance(l, Conv2D))
+        assert conv.dilation == (2, 2)
+        from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+        m = MultiLayerNetwork(conf).init()
+        # dilated 3x3 valid on 12x12 -> 8x8 spatial
+        out = np.asarray(m.output(np.random.RandomState(0)
+                                  .rand(2, 12, 12, 1).astype(np.float32)))
+        assert out.shape == (2, 4)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+
+    def test_atrous_conv1d_maps_to_dilated_conv1d(self):
+        conf = KerasModelImport.import_keras_sequential_configuration(json.dumps({
+            "class_name": "Sequential",
+            "config": [
+                {"class_name": "AtrousConvolution1D", "config": {
+                    "batch_input_shape": [None, 16, 2],
+                    "nb_filter": 3, "filter_length": 3,
+                    "atrous_rate": 2, "subsample_length": 1,
+                    "border_mode": "valid", "activation": "relu",
+                    "name": "aconv1"}},
+                {"class_name": "GlobalAveragePooling1D",
+                 "config": {"name": "gap"}},
+                {"class_name": "Dense", "config": {
+                    "output_dim": 2, "activation": "softmax", "name": "out"}},
+            ],
+        }))
+        from deeplearning4j_tpu.nn.layers import Conv1D
+        conv = next(l for l in conf.layers if isinstance(l, Conv1D))
+        assert conv.dilation == 2
+        from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+        m = MultiLayerNetwork(conf).init()
+        out = np.asarray(m.output(np.random.RandomState(1)
+                                  .rand(2, 16, 2).astype(np.float32)))
+        assert out.shape == (2, 2)

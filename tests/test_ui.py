@@ -6,6 +6,7 @@ import json
 import urllib.request
 
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.nn.input_type import InputType
 from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
@@ -97,3 +98,65 @@ class TestStatsChain:
             assert st[0]["sessions"] == ["test-run"]
         finally:
             ui.stop()
+
+
+class TestTsnePage:
+    """/tsne embedding page (reference deeplearning4j-play TsneModule)."""
+
+    def test_upload_and_render(self):
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        srv = UIServer()
+        coords = np.asarray([[0.0, 0.0], [1.0, 2.0], [-1.5, 0.5]])
+        srv.upload_tsne(coords, labels=["cat", "dog", "fish"])
+        page = srv.render_tsne_html()
+        assert "<svg" in page and "cat" in page and "fish" in page
+        assert page.count("<circle") == 3
+
+    def test_http_roundtrip(self):
+        import json as _json
+        import urllib.request
+
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        srv = UIServer()
+        srv.serve(port=0)
+        try:
+            url = f"http://127.0.0.1:{srv.port}/tsne"
+            body = _json.dumps({"coords": [[0, 0], [3, 4]],
+                                "labels": ["a", "b"],
+                                "name": "words"}).encode()
+            urllib.request.urlopen(urllib.request.Request(
+                url, body, {"Content-Type": "application/json"}))
+            page = urllib.request.urlopen(url).read().decode()
+            assert "words" in page and page.count("<circle") == 2
+            # bad payload -> 400
+            try:
+                urllib.request.urlopen(urllib.request.Request(
+                    url, b'{"coords": [[1]]}',
+                    {"Content-Type": "application/json"}))
+                raise AssertionError("expected 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            srv.stop()
+
+    def test_bad_coords_rejected(self):
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        srv = UIServer()
+        with pytest.raises(ValueError, match="coords"):
+            srv.upload_tsne(np.zeros((3,)))
+        with pytest.raises(ValueError, match="labels"):
+            srv.upload_tsne(np.zeros((3, 2)), labels=["x"])
+
+    def test_end_to_end_from_tsne_engine(self):
+        """clustering.Tsne output flows straight onto the page."""
+        from deeplearning4j_tpu.clustering.tsne import Tsne
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        x = np.random.RandomState(0).rand(20, 6).astype(np.float32)
+        emb = Tsne(n_iter=30, perplexity=5.0).fit_transform(x)
+        srv = UIServer().upload_tsne(emb, labels=[f"w{i}" for i in range(20)])
+        page = srv.render_tsne_html()
+        assert page.count("<circle") == 20
