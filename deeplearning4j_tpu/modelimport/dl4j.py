@@ -1337,10 +1337,145 @@ def _export_updater_state(model, export_entries) -> np.ndarray:
             if pieces else np.zeros((0,), np.float32))
 
 
+def _vertex_to_dl4j_json(v) -> dict:
+    """Inverse of ``_build_cg.make_vertex`` (conf/graph/GraphVertex.java
+    WRAPPER_OBJECT names)."""
+    name = type(v).__name__
+    if name == "MergeVertex":
+        return {"MergeVertex": {}}
+    if name == "ElementWiseVertex":
+        return {"ElementWiseVertex": {"op": str(v.op).capitalize()}}
+    if name == "SubsetVertex":
+        return {"SubsetVertex": {"from": v.from_index, "to": v.to_index}}
+    if name == "ScaleVertex":
+        return {"ScaleVertex": {"scaleFactor": v.scale}}
+    if name == "ShiftVertex":
+        return {"ShiftVertex": {"shiftFactor": v.shift}}
+    if name == "StackVertex":
+        return {"StackVertex": {}}
+    if name == "UnstackVertex":
+        return {"UnstackVertex": {"from": v.from_index,
+                                  "stackSize": v.stack_size}}
+    if name == "L2Vertex":
+        return {"L2Vertex": {"eps": v.eps}}
+    if name == "L2NormalizeVertex":
+        return {"L2NormalizeVertex": {"eps": v.eps}}
+    raise ValueError(
+        f"export_dl4j_zip: graph vertex {name} has no DL4J equivalent")
+
+
+def _export_cg_zip(model, path: str):
+    """ComputationGraph -> reference CG zip: vertices emitted in topological
+    order (so the reference's vertex numbering and param-flattening walk —
+    see ``_dl4j_topo_order`` — reproduce this exporter's segment order),
+    LayerVertices carrying cnnToFeedForward preProcessors where our resolver
+    inserted one (which is also what makes re-import's input-type inference
+    work), plus coefficients.bin and updaterState.bin."""
+    conf = model.conf
+    gspec = _canon_spec(conf.updater)
+    inputs = list(conf.inputs)
+    vertices_json: Dict[str, dict] = {}
+    vertex_inputs: Dict[str, list] = {}
+
+    def layer_in_type(rt):
+        """(the in_type _export_layer uses, the preProcessor JSON to store
+        — WRAPPER_OBJECT names the importer and the reference both read)."""
+        in_type = rt.input_types[0]
+        src_t = model.vertex_types.get(rt.inputs[0])
+        if rt.pre is None or src_t is None:
+            return in_type, None
+        pname = type(rt.pre).__name__
+        if pname == "CnnToFeedForward":
+            # dense-after-conv: the flatten permutation needs the CONV shape
+            return src_t, {"cnnToFeedForward": {
+                "inputHeight": src_t.height, "inputWidth": src_t.width,
+                "numChannels": src_t.channels}}
+        if pname == "FeedForwardToCnn":
+            return in_type, {"feedForwardToCnn": {
+                "inputHeight": in_type.height, "inputWidth": in_type.width,
+                "numChannels": in_type.channels}}
+        if pname == "RnnToFeedForward":
+            return in_type, {"rnnToFeedForward": {}}
+        if pname == "FeedForwardToRnn":
+            return in_type, {"feedForwardToRnn": {}}
+        if pname == "CnnToRnn":
+            return in_type, {"cnnToRnn": {
+                "inputHeight": src_t.height, "inputWidth": src_t.width,
+                "numChannels": src_t.channels}}
+        if pname == "RnnToCnn":
+            return in_type, {"rnnToCnn": {
+                "inputHeight": in_type.height, "inputWidth": in_type.width,
+                "numChannels": in_type.channels}}
+        raise ValueError(
+            f"export_dl4j_zip: auto-inserted preprocessor {pname} has no "
+            "DL4J InputPreProcessor equivalent")
+
+    # pass 1: the conf JSON, vertices keyed in our topological order; the
+    # per-vertex flat segment is cached so pass 2 only reorders
+    seg_of: Dict[str, np.ndarray] = {}
+    entry_of: Dict[str, tuple] = {}
+    for name in model.topo_order:
+        rt = model.rt[name]
+        vertex_inputs[name] = list(rt.inputs)
+        if not rt.spec.is_layer():
+            vertices_json[name] = _vertex_to_dl4j_json(rt.config)
+            continue
+        in_type, pp = layer_in_type(rt)
+        obj, seg = _export_layer(rt.config, model.params.get(name) or {},
+                                 model.state.get(name) or {}, in_type)
+        if obj is None:
+            raise ValueError(
+                f"export_dl4j_zip: CG vertex {name!r} produced no DL4J layer")
+        t = next(iter(obj))
+        if _dl4j_var_sizes(rt.config, in_type):
+            obj[t].setdefault(
+                "iUpdater",
+                _updater_to_dl4j_json(_export_layer_spec(rt.config, gspec)))
+        lv: Dict[str, Any] = {"layerConf": {
+            "layer": obj,
+            "iterationCount": int(getattr(model, "iteration", 0))}}
+        if pp is not None:
+            lv["preProcessor"] = pp
+        vertices_json[name] = {"LayerVertex": lv}
+        seg_of[name] = seg
+        entry_of[name] = (rt.config, in_type, name)
+
+    # pass 2: coefficients in the order the IMPORTER (and the reference
+    # runtime) will consume them — the Kahn walk over the numbering the
+    # JSON defines, which is NOT always our emission order (two valid
+    # topological orders of the same DAG can differ)
+    ref_order = _dl4j_topo_order(inputs, list(vertices_json), vertex_inputs)
+    segs = [seg_of[n] for n in ref_order if n in seg_of]
+    export_entries = [entry_of[n] for n in ref_order if n in entry_of]
+
+    conf_json = {
+        "networkInputs": inputs,
+        "networkOutputs": list(conf.outputs),
+        "vertexInputs": vertex_inputs,
+        "vertices": vertices_json,
+    }
+    flat = np.concatenate(segs) if segs else np.zeros((0,), np.float32)
+    buf = io.BytesIO()
+    write_nd4j(buf, flat[None, :], "FLOAT")
+    ustate = _export_updater_state(model, export_entries)
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("configuration.json", json.dumps(conf_json))
+        zf.writestr("coefficients.bin", buf.getvalue())
+        if ustate.size:
+            ubuf = io.BytesIO()
+            write_nd4j(ubuf, ustate[None, :], "FLOAT")
+            zf.writestr("updaterState.bin", ubuf.getvalue())
+
+
 def export_dl4j_zip(model, path: str):
-    """Write a MultiLayerNetwork in the reference's zip format
-    (configuration.json + coefficients.bin + updaterState.bin) so DL4J can
-    load our models and resume training with the optimizer state intact."""
+    """Write a MultiLayerNetwork OR ComputationGraph in the reference's zip
+    format (configuration.json + coefficients.bin + updaterState.bin) so
+    DL4J can load our models and resume training with the optimizer state
+    intact."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    if isinstance(model, ComputationGraph):
+        return _export_cg_zip(model, path)
     mlc = model.conf
     gspec = _canon_spec(mlc.updater)
     confs = []
