@@ -105,10 +105,16 @@ class BaseRecurrent(FeedForwardLayerConfig):
             return new_c, out
 
         xs = jnp.swapaxes(stream, 0, 1)  # [time, batch, feat] for scan
-        # unroll so XLA can pipeline the small recurrent matmuls across
-        # steps ([B,H]x[H,4H] alone can't fill the chip): +46% tokens/sec
-        # on the char-RNN bench at T=50 (docs/PERF.md)
-        unroll = max(1, min(8, xs.shape[0]))
+        # Scan unroll, overridable via DL4J_TPU_RNN_UNROLL. Round-4 honest
+        # re-measure (fresh-process A/B, value-fetch sync): unroll 1/8/50
+        # all land within run-to-run noise (~1.8-2.0M tokens/s on the
+        # char-RNN bench) — the round-3 "+46% at unroll=8" was a phantom of
+        # the sync-elision measurement bug (docs/PERF.md correction).
+        # Default kept at 8: never measured worse, bounds compile time.
+        import os as _os
+
+        cap = int(_os.environ.get("DL4J_TPU_RNN_UNROLL", "8"))
+        unroll = max(1, min(cap, xs.shape[0]))
         if mask is not None:
             ms = jnp.swapaxes(mask.astype(x.dtype), 0, 1)
             final, outs = lax.scan(step, carry, (xs, ms), unroll=unroll)
