@@ -79,6 +79,26 @@ class Evaluation:
     def eval(self, labels, predictions, mask=None):
         labels = np.asarray(labels)
         predictions = np.asarray(predictions)
+        if (labels.dtype.kind in "iu"
+                and labels.ndim == predictions.ndim - 1):
+            # sparse integer class labels ([B] or [B,T]) — same convention
+            # the softmax+mcxent loss head accepts
+            n = predictions.shape[-1]
+            actual = labels.reshape(-1).astype(np.int64)
+            predictions = predictions.reshape(-1, n)
+            if mask is not None:
+                keep = np.asarray(mask).reshape(-1) > 0
+                actual, predictions = actual[keep], predictions[keep]
+            self._ensure(n)
+            predicted = predictions.argmax(axis=-1)
+            self.confusion.add(actual, predicted)
+            self.examples += len(actual)
+            if self.top_n > 1:
+                top = np.argsort(-predictions, axis=-1)[:, : self.top_n]
+                self.top_n_correct += int(
+                    (top == actual[:, None]).any(axis=-1).sum())
+                self.top_n_total += len(actual)
+            return
         if labels.ndim == 3:  # time series: flatten (+ mask)
             n = labels.shape[-1]
             labels = labels.reshape(-1, n)

@@ -127,7 +127,11 @@ class CenterLossOutputLayer(FeedForwardLayerConfig):
     def score(self, params, x, labels, mask=None, average=True, weights=None):
         preact = x @ params["W"] + params["b"]
         base = losses.average_score(self.loss, labels, preact, self.activation, mask, weights)
-        centers_for = labels @ params["centers"]  # one-hot labels pick rows
+        if jnp.asarray(labels).ndim == preact.ndim - 1:
+            # sparse integer labels index their centers directly
+            centers_for = params["centers"][jnp.asarray(labels).astype(jnp.int32)]
+        else:
+            centers_for = labels @ params["centers"]  # one-hot picks rows
         center_term = 0.5 * jnp.mean(jnp.sum((x - centers_for) ** 2, axis=-1))
         # alpha folds into the centers' learning rate via the term scale
         return base + self.lambda_ * self.alpha / 0.05 * center_term

@@ -183,8 +183,35 @@ def per_example_scores(
     if loss_name is not None:
         loss_name = loss_name.lower()
 
+    labels = jnp.asarray(labels)
+    # SPARSE integer labels (beyond-reference convenience): class INDICES of
+    # rank preact.ndim-1 instead of one-hot — at vocab-scale heads this
+    # removes the [B,(T,)C] one-hot tensor entirely (268MB at B16 T2048
+    # V2048 f32). Supported for the fused softmax+MCXENT path only.
+    sparse = (labels.ndim == preact.ndim - 1
+              and jnp.issubdtype(labels.dtype, jnp.integer))
+    if sparse and not (loss_name in ("mcxent", "negativeloglikelihood")
+                       and str(activation).lower() == "softmax"):
+        raise ValueError(
+            "integer (sparse) labels are only supported for the "
+            "softmax+mcxent loss head; one-hot labels required for "
+            f"loss={loss_name!r} activation={activation!r}")
+
     if loss_name in ("mcxent", "negativeloglikelihood") and str(activation).lower() == "softmax":
         logp = jax.nn.log_softmax(preact, axis=-1)
+        if sparse:
+            lab = labels.astype(jnp.int32)
+            ce = -jnp.take_along_axis(logp, lab[..., None], axis=-1)[..., 0]
+            if weights is not None:
+                ce = ce * jnp.asarray(weights, ce.dtype)[lab]
+            if preact.ndim == 3 and mask is not None and mask.ndim == 2:
+                return jnp.sum(ce * mask, axis=-1)
+            if preact.ndim == 3:
+                # dense convention: per-example score sums over time
+                return jnp.sum(ce, axis=-1)
+            if mask is not None:
+                ce = ce * mask.reshape(ce.shape)
+            return ce  # [B]
         elem = -labels * logp
         if weights is not None:
             elem = elem * jnp.asarray(weights, elem.dtype)
@@ -229,7 +256,7 @@ def average_score(
     """Mean loss over examples (over unmasked timesteps for rank-3 + mask),
     matching the reference's score averaging in BaseOutputLayer.computeScore."""
     scores = per_example_scores(loss, labels, preact, activation, mask, weights)
-    if mask is not None and labels.ndim == 3 and mask.ndim == 2:
+    if mask is not None and preact.ndim == 3 and mask.ndim == 2:
         denom = jnp.maximum(jnp.sum(mask), 1.0)
         return jnp.sum(scores) / denom
     if mask is not None:

@@ -160,6 +160,15 @@ def _as_batch(batch):
     return batch, None, None, None
 
 
+def _cast_labels(y, dtype):
+    """Model-dtype cast that PRESERVES integer (sparse) class labels — the
+    loss head's sparse path needs the integer dtype intact."""
+    if y is None:
+        return None
+    y = jnp.asarray(y)
+    return y if jnp.issubdtype(y.dtype, jnp.integer) else y.astype(dtype)
+
+
 def _iter_batches(data, batch_size=None):
     """Yield batches from (x, y[, masks]) arrays (optionally minibatched), a
     DataSet object, or any iterable of batches."""
@@ -432,7 +441,7 @@ class MultiLayerNetwork:
         consumed by batch-coupled layers — see _forward."""
         step = self._get_step_fn(False)
         x = _cast_input(x, self.dtype)
-        y = jnp.asarray(y, self.dtype) if y is not None else None
+        y = _cast_labels(y, self.dtype)
         fm = jnp.asarray(fm, self.dtype) if fm is not None else None
         lm = jnp.asarray(lm, self.dtype) if lm is not None else None
         self.params, self.opt_state, self.state, _, loss = step(
@@ -474,8 +483,11 @@ class MultiLayerNetwork:
         for t0 in range(0, T, L):
             sl = slice(t0, min(t0 + L, T))
             xc = jnp.asarray(x[:, sl], self.dtype)
-            yc = jnp.asarray(y[:, sl], self.dtype) if y is not None and np.ndim(y) == 3 else (
-                jnp.asarray(y, self.dtype) if y is not None else None)
+            # time-sliced labels: one-hot [B,T,C] AND sparse integer [B,T];
+            # rank-2 FLOAT labels (sequence-level heads) pass through whole
+            y_sliced = (y is not None and (np.ndim(y) == 3 or (
+                np.ndim(y) == 2 and np.asarray(y).dtype.kind in "iu")))
+            yc = _cast_labels(y[:, sl] if y_sliced else y, self.dtype)
             fmc = jnp.asarray(fm[:, sl], self.dtype) if fm is not None else None
             lmc = jnp.asarray(lm[:, sl], self.dtype) if lm is not None else None
             self.params, self.opt_state, self.state, carries, loss = step(
@@ -516,7 +528,7 @@ class MultiLayerNetwork:
             x = batch_or_x
         loss, _ = self._loss(
             self.params, self.state,
-            _cast_input(x, self.dtype), jnp.asarray(y, self.dtype),
+            _cast_input(x, self.dtype), _cast_labels(y, self.dtype),
             jnp.asarray(fmask, self.dtype) if fmask is not None else None,
             jnp.asarray(lmask, self.dtype) if lmask is not None else None,
             rngs=None,

@@ -74,7 +74,11 @@ class ParallelWrapper:
             return None
         from deeplearning4j_tpu.parallel.distributed import global_array
 
-        arr = np.asarray(arr, self.model.dtype)  # before .ndim: lists welcome
+        arr = np.asarray(arr)  # before .ndim: lists welcome
+        if arr.dtype.kind not in "iub":
+            # preserve integer/bool arrays: token-id features and sparse
+            # class labels must not round-trip through the float model dtype
+            arr = arr.astype(self.model.dtype)
         spec = P("data", *([None] * (arr.ndim - 1)))
         return global_array(self.mesh, arr, spec)
 

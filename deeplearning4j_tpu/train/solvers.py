@@ -98,9 +98,11 @@ class Solver:
         writes the optimized params back into the model."""
         from deeplearning4j_tpu.nn.model import _as_batch
 
+        from deeplearning4j_tpu.nn.model import _cast_input, _cast_labels
+
         x, y, fm, lm = _as_batch(data)
-        x = jnp.asarray(x, self.model.dtype)
-        y = jnp.asarray(y, self.model.dtype) if y is not None else None
+        x = _cast_input(x, self.model.dtype)
+        y = _cast_labels(y, self.model.dtype)
         flat, unravel = self._build(x, y, fm, lm)
 
         f0, g = self._vg(flat)

@@ -1,0 +1,208 @@
+"""Sparse integer class labels for the softmax+mcxent head (beyond-
+reference: DL4J requires one-hot; at vocab-scale heads one-hot labels
+dominate host->device traffic). Training with indices must be bit-
+equivalent to training with the corresponding one-hot labels."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers import Dense, LSTM, OutputLayer, RnnOutputLayer
+from deeplearning4j_tpu.nn.model import MultiLayerConfiguration, MultiLayerNetwork
+
+
+def _mk(out_cls=OutputLayer, n_in=6, n_out=4, **kw):
+    layers = (Dense(n_out=8, activation="tanh"),
+              out_cls(n_out=n_out, activation="softmax", loss="mcxent"))
+    return MultiLayerConfiguration(
+        layers=layers, input_type=InputType.feed_forward(n_in),
+        updater={"type": "adam", "lr": 5e-3}, seed=3, **kw)
+
+
+class TestSparseLabels:
+    def test_dense_head_sparse_equals_onehot(self):
+        rs = np.random.RandomState(0)
+        x = rs.rand(16, 6).astype(np.float32)
+        yi = rs.randint(0, 4, 16)
+        yh = np.eye(4, dtype=np.float32)[yi]
+
+        a = MultiLayerNetwork(_mk()).init()
+        a.fit((x, yh), epochs=3)
+        b = MultiLayerNetwork(_mk()).init()
+        b.fit((x, yi.astype(np.int32)), epochs=3)
+        for i in range(len(a.params)):
+            for k in a.params[i] or {}:
+                np.testing.assert_allclose(
+                    np.asarray(a.params[i][k]), np.asarray(b.params[i][k]),
+                    rtol=1e-6, atol=1e-7, err_msg=f"layer {i} {k}")
+
+    def test_rnn_head_sparse_equals_onehot_with_mask(self):
+        rs = np.random.RandomState(1)
+        B, T, F, C = 4, 7, 3, 5
+        conf = lambda: MultiLayerConfiguration(
+            layers=(LSTM(n_out=6, activation="tanh"),
+                    RnnOutputLayer(n_out=C, activation="softmax",
+                                   loss="mcxent")),
+            input_type=InputType.recurrent(F),
+            updater={"type": "sgd", "lr": 0.05}, seed=5)
+        x = rs.rand(B, T, F).astype(np.float32)
+        yi = rs.randint(0, C, (B, T))
+        yh = np.eye(C, dtype=np.float32)[yi]
+        lm = (rs.rand(B, T) > 0.3).astype(np.float32)
+        lm[:, 0] = 1.0
+
+        a = MultiLayerNetwork(conf()).init()
+        a.fit((x, yh, None, lm), epochs=2)
+        b = MultiLayerNetwork(conf()).init()
+        b.fit((x, yi.astype(np.int32), None, lm), epochs=2)
+        for i in range(len(a.params)):
+            for k in a.params[i] or {}:
+                np.testing.assert_allclose(
+                    np.asarray(a.params[i][k]), np.asarray(b.params[i][k]),
+                    rtol=1e-5, atol=1e-6, err_msg=f"layer {i} {k}")
+
+    def test_sparse_score_matches_onehot(self):
+        rs = np.random.RandomState(2)
+        x = rs.rand(8, 6).astype(np.float32)
+        yi = rs.randint(0, 4, 8)
+        yh = np.eye(4, dtype=np.float32)[yi]
+        m = MultiLayerNetwork(_mk()).init()
+        s_hot = float(m.score((x, yh)))
+        s_idx = float(m.score((x, yi.astype(np.int32))))
+        np.testing.assert_allclose(s_idx, s_hot, rtol=1e-6)
+
+    def test_sparse_rejected_for_other_losses(self):
+        conf = MultiLayerConfiguration(
+            layers=(Dense(n_out=8, activation="tanh"),
+                    OutputLayer(n_out=4, activation="identity", loss="mse")),
+            input_type=InputType.feed_forward(6),
+            updater={"type": "sgd", "lr": 0.05}, seed=3)
+        m = MultiLayerNetwork(conf).init()
+        rs = np.random.RandomState(3)
+        x = rs.rand(8, 6).astype(np.float32)
+        with pytest.raises(ValueError, match="sparse"):
+            m.score((x, rs.randint(0, 4, 8).astype(np.int32)))
+
+    def test_rnn_head_sparse_equals_onehot_no_mask(self):
+        """Rank-3 WITHOUT a mask: the per-example score sums over time in
+        both conventions (the same loss scale, hence the same gradients)."""
+        rs = np.random.RandomState(4)
+        B, T, F, C = 4, 6, 3, 5
+        conf = lambda: MultiLayerConfiguration(
+            layers=(LSTM(n_out=6, activation="tanh"),
+                    RnnOutputLayer(n_out=C, activation="softmax",
+                                   loss="mcxent")),
+            input_type=InputType.recurrent(F),
+            updater={"type": "sgd", "lr": 0.05}, seed=5)
+        x = rs.rand(B, T, F).astype(np.float32)
+        yi = rs.randint(0, C, (B, T))
+        yh = np.eye(C, dtype=np.float32)[yi]
+        a = MultiLayerNetwork(conf()).init()
+        a.fit((x, yh), epochs=2)
+        b = MultiLayerNetwork(conf()).init()
+        b.fit((x, yi.astype(np.int32)), epochs=2)
+        s_hot = float(a.score((x, yh)))
+        s_idx = float(b.score((x, yi.astype(np.int32))))
+        np.testing.assert_allclose(s_idx, s_hot, rtol=1e-5)
+        for i in range(len(a.params)):
+            for k in a.params[i] or {}:
+                np.testing.assert_allclose(
+                    np.asarray(a.params[i][k]), np.asarray(b.params[i][k]),
+                    rtol=1e-5, atol=1e-6, err_msg=f"layer {i} {k}")
+
+    def test_tbptt_sparse_equals_onehot(self):
+        rs = np.random.RandomState(6)
+        B, T, F, C = 4, 12, 3, 5
+        conf = lambda: MultiLayerConfiguration(
+            layers=(LSTM(n_out=6, activation="tanh"),
+                    RnnOutputLayer(n_out=C, activation="softmax",
+                                   loss="mcxent")),
+            input_type=InputType.recurrent(F),
+            updater={"type": "sgd", "lr": 0.05}, seed=5,
+            backprop_type="tbptt", tbptt_fwd_length=4, tbptt_back_length=4)
+        x = rs.rand(B, T, F).astype(np.float32)
+        yi = rs.randint(0, C, (B, T))
+        yh = np.eye(C, dtype=np.float32)[yi]
+        a = MultiLayerNetwork(conf()).init()
+        a.fit((x, yh), epochs=2)
+        b = MultiLayerNetwork(conf()).init()
+        b.fit((x, yi.astype(np.int32)), epochs=2)
+        for i in range(len(a.params)):
+            for k in a.params[i] or {}:
+                np.testing.assert_allclose(
+                    np.asarray(a.params[i][k]), np.asarray(b.params[i][k]),
+                    rtol=1e-5, atol=1e-6, err_msg=f"layer {i} {k} (tbptt)")
+
+    def test_parallel_wrapper_sparse_equals_onehot(self):
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+        from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+
+        rs = np.random.RandomState(7)
+        x = rs.rand(16, 6).astype(np.float32)
+        yi = rs.randint(0, 4, 16)
+        yh = np.eye(4, dtype=np.float32)[yi]
+        mesh = make_mesh(MeshSpec(data=8))
+        a = MultiLayerNetwork(_mk()).init()
+        ParallelWrapper(a, mesh).fit((x, yh), epochs=2)
+        b = MultiLayerNetwork(_mk()).init()
+        ParallelWrapper(b, mesh).fit((x, yi.astype(np.int32)), epochs=2)
+        for i in range(len(a.params)):
+            for k in a.params[i] or {}:
+                np.testing.assert_allclose(
+                    np.asarray(a.params[i][k]), np.asarray(b.params[i][k]),
+                    rtol=1e-5, atol=1e-6, err_msg=f"layer {i} {k} (pw)")
+
+    def test_solver_path_sparse(self):
+        conf = MultiLayerConfiguration(
+            layers=(Dense(n_out=8, activation="tanh"),
+                    OutputLayer(n_out=4, activation="softmax", loss="mcxent")),
+            input_type=InputType.feed_forward(6),
+            updater={"type": "sgd", "lr": 0.05}, seed=3,
+            optimization_algo="lbfgs", solver_iterations=2)
+        m = MultiLayerNetwork(conf).init()
+        rs = np.random.RandomState(8)
+        x = rs.rand(8, 6).astype(np.float32)
+        yi = rs.randint(0, 4, 8).astype(np.int32)
+        m.fit((x, yi))
+        assert np.isfinite(float(m.score((x, yi))))
+
+    def test_evaluate_sparse_labels(self):
+        from deeplearning4j_tpu.eval import Evaluation
+
+        rs = np.random.RandomState(9)
+        # rank-2 predictions + [B] int labels
+        e = Evaluation()
+        preds = rs.rand(10, 4)
+        yi = rs.randint(0, 4, 10)
+        e.eval(yi.astype(np.int32), preds)
+        e2 = Evaluation()
+        e2.eval(np.eye(4)[yi], preds)
+        assert e.accuracy() == e2.accuracy()
+        # rank-3 predictions + [B,T] int labels + mask
+        e3 = Evaluation()
+        predsT = rs.rand(3, 5, 4)
+        yiT = rs.randint(0, 4, (3, 5))
+        mask = (rs.rand(3, 5) > 0.4).astype(np.float32)
+        e3.eval(yiT.astype(np.int32), predsT, mask=mask)
+        e4 = Evaluation()
+        e4.eval(np.eye(4)[yiT], predsT, mask=mask)
+        assert e3.accuracy() == e4.accuracy()
+        assert e3.examples == e4.examples
+
+    def test_center_loss_sparse_equals_onehot(self):
+        from deeplearning4j_tpu.nn.layers import CenterLossOutputLayer
+
+        conf = lambda: MultiLayerConfiguration(
+            layers=(Dense(n_out=8, activation="tanh"),
+                    CenterLossOutputLayer(n_out=4, activation="softmax",
+                                          loss="mcxent")),
+            input_type=InputType.feed_forward(6),
+            updater={"type": "sgd", "lr": 0.05}, seed=3)
+        rs = np.random.RandomState(10)
+        x = rs.rand(8, 6).astype(np.float32)
+        yi = rs.randint(0, 4, 8)
+        yh = np.eye(4, dtype=np.float32)[yi]
+        a = MultiLayerNetwork(conf()).init()
+        s_hot = float(a.score((x, yh)))
+        s_idx = float(a.score((x, yi.astype(np.int32))))
+        np.testing.assert_allclose(s_idx, s_hot, rtol=1e-6)
