@@ -54,7 +54,7 @@ def _cdiv(a: int, b: int) -> int:
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
             block_k: int, t_real: int, t_pad: int, causal: bool,
-            scale: float):
+            scale: float, q_off: int = 0, k_off: int = 0):
     """One q-block vs all key blocks. Refs: q [1, block_q, D];
     k/v [1, t_pad, D]; o [1, block_q, D]; lse [1, 1, block_q].
 
@@ -66,10 +66,11 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
     sentinel — is what keeps padded rows out of dk/dv."""
     qi = pl.program_id(1)
     # operands stay in their native dtype (bf16 keeps the MXU at full rate);
-    # scores, softmax state and the accumulator are f32
+    # scores, softmax state and the accumulator are f32. q_off/k_off are
+    # ABSOLUTE sequence offsets (ring/chunked attention blocks).
     q = q_ref[0]                                                 # [bq, D]
     d = q.shape[-1]
-    q_pos = qi * block_q + lax.broadcasted_iota(
+    q_pos = q_off + qi * block_q + lax.broadcasted_iota(
         jnp.int32, (block_q, 1), 0)                              # [bq, 1]
 
     m0 = jnp.full((block_q, 1), _NEG_BIG, jnp.float32)
@@ -82,9 +83,9 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
         v = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = jnp.dot(q, k.T,
                     preferred_element_type=jnp.float32) * scale  # [bq, bk]
-        k_pos = kb * block_k + lax.broadcasted_iota(
+        k_pos = k_off + kb * block_k + lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1)                          # [1, bk]
-        valid = k_pos < t_real
+        valid = k_pos < k_off + t_real
         if causal:
             valid = jnp.logical_and(valid, k_pos <= q_pos)
         s = jnp.where(valid, s, _NEG_BIG)
@@ -97,9 +98,12 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
         return m_new, l, acc
 
     n_kb = t_pad // block_k
-    if causal:
-        # key blocks strictly above the diagonal contribute nothing:
-        # stop after the block containing this q-block's last position
+    if causal and q_off == k_off:
+        # key blocks strictly above the diagonal contribute nothing: stop
+        # after the block containing this q-block's last position. Equal
+        # offsets (incl. the ring schedule's diagonal chunk) reduce
+        # k_pos <= q_pos to the same local comparison as the unshifted
+        # case; for unequal offsets masking alone stays correct.
         n_kb = jnp.minimum(n_kb, (qi + 1) * block_q // block_k
                            + (1 if block_q % block_k else 0))
     m, l, acc = lax.fori_loop(0, n_kb, body, (m0, l0, acc0))
@@ -129,6 +133,38 @@ def _block_sizes(T, block_q, block_k):
     return bq, bk, t_pad
 
 
+def _fwd_pallas_call(qt, kt, vt, *, D, bq, bk, q_pad, k_pad, t_real_k,
+                     causal, scale, q_off, k_off, interpret, dtype):
+    """The shared forward pallas_call (main path and chunked-block path):
+    padded [BH, q_pad, D] q and [BH, k_pad, D] k/v -> ([BH, q_pad, D] out,
+    [BH, 1, q_pad] row-layout lse)."""
+    BH = qt.shape[0]
+    kernel = functools.partial(
+        _kernel, block_q=bq, block_k=bk, t_real=t_real_k, t_pad=k_pad,
+        causal=causal, scale=scale, q_off=q_off, k_off=k_off)
+    kw = {}
+    if _VMEM is not None and not interpret:
+        kw["memory_space"] = _VMEM
+    return pl.pallas_call(
+        kernel,
+        grid=(BH, q_pad // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0), **kw),
+            pl.BlockSpec((1, k_pad, D), lambda bh, qi: (bh, 0, 0), **kw),
+            pl.BlockSpec((1, k_pad, D), lambda bh, qi: (bh, 0, 0), **kw),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0), **kw),
+            pl.BlockSpec((1, 1, bq), lambda bh, qi: (bh, 0, qi), **kw),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, q_pad, D), dtype),
+            jax.ShapeDtypeStruct((BH, 1, q_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+
+
 def _flash_raw(q, k, v, causal: bool, block_q: int, block_k: int,
                interpret: bool, with_lse: bool = False):
     """q/k/v: [B, T, H, D] -> [B, T, H, D] (plus the [B*H, 1, t_pad] row
@@ -136,33 +172,11 @@ def _flash_raw(q, k, v, causal: bool, block_q: int, block_k: int,
     B, T, H, D = q.shape
     scale = 1.0 / (D ** 0.5)
     bq, bk, t_pad = _block_sizes(T, block_q, block_k)
-
     qt, kt, vt = (_pad_bh(x, t_pad) for x in (q, k, v))
-    grid = (B * H, t_pad // bq)
-    kernel = functools.partial(
-        _kernel, block_q=bq, block_k=bk, t_real=T, t_pad=t_pad,
-        causal=causal, scale=scale)
-    kw = {}
-    if _VMEM is not None and not interpret:
-        kw["memory_space"] = _VMEM
-    out, lse = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0), **kw),
-            pl.BlockSpec((1, t_pad, D), lambda bh, qi: (bh, 0, 0), **kw),
-            pl.BlockSpec((1, t_pad, D), lambda bh, qi: (bh, 0, 0), **kw),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0), **kw),
-            pl.BlockSpec((1, 1, bq), lambda bh, qi: (bh, 0, qi), **kw),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B * H, t_pad, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, 1, t_pad), jnp.float32),
-        ],
-        interpret=interpret,
-    )(qt, kt, vt)
+    out, lse = _fwd_pallas_call(
+        qt, kt, vt, D=D, bq=bq, bk=bk, q_pad=t_pad, k_pad=t_pad, t_real_k=T,
+        causal=causal, scale=scale, q_off=0, k_off=0, interpret=interpret,
+        dtype=q.dtype)
     res = _from_bh(out, B, T, H)
     return (res, lse) if with_lse else res
 
@@ -416,6 +430,55 @@ def flash_attention(q, k, v, *, causal: bool = False, block_q: int = 128,
     if bwd not in ("pallas", "xla"):
         raise ValueError(f"bwd must be 'pallas' or 'xla', got {bwd!r}")
     return _flash(q, k, v, causal, block_q, block_k, interpret, bwd)
+
+
+def flash_attention_block(q, k, v, *, q_offset: int = 0, k_offset: int = 0,
+                          causal: bool = False, block_q: int = 128,
+                          block_k: int = 128, interpret: bool = False):
+    """FORWARD-ONLY building block for chunked/ring attention: attention of
+    q (absolute positions starting at ``q_offset``) over ONE k/v chunk
+    (positions starting at ``k_offset``), returning
+    ``(out, lse [B, H, T])`` — the per-row logsumexp needed to merge
+    partial results across chunks with :func:`merge_attention_blocks`.
+
+    Rows whose keys are entirely masked (causal, q < k_offset) return a
+    ~-1e30 lse whose merge weight underflows to exactly 0 — but their
+    ``out`` is mean(v), NOT 0 (every masked score equals the running-max
+    sentinel, so p=1 uniformly). ``out`` alone is therefore meaningless
+    without the lse weighting: always combine via merge_attention_blocks."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = 1.0 / (D ** 0.5)
+    bq = min(block_q, max(Tq, 1))
+    bk = min(block_k, max(Tk, 1))
+    q_pad = _cdiv(Tq, bq) * bq
+    k_pad = _cdiv(Tk, bk) * bk
+    qt = _pad_bh(q, q_pad)
+    kt, vt = _pad_bh(k, k_pad), _pad_bh(v, k_pad)
+    # t_real_k gates KEY validity (Tk, not Tq — the chunk may be shorter);
+    # padded q rows emit garbage that is sliced off below
+    out, lse = _fwd_pallas_call(
+        qt, kt, vt, D=D, bq=bq, bk=bk, q_pad=q_pad, k_pad=k_pad, t_real_k=Tk,
+        causal=causal, scale=scale, q_off=q_offset, k_off=k_offset,
+        interpret=interpret, dtype=q.dtype)
+    # fully masked rows: m stays _NEG_BIG so lse = m + log(l) is ~-1e30
+    # and the merge weight underflows to 0 (their out is mean(v), see
+    # docstring — only the weighted combination is meaningful)
+    lse_b = lse[:, 0, :Tq].reshape(B, H, Tq)
+    return _from_bh(out, B, Tq, H), lse_b
+
+
+def merge_attention_blocks(parts):
+    """Merge [(out_i [B,T,H,D], lse_i [B,H,T])] partial attentions over
+    DISJOINT key chunks into the attention over their union:
+    out = sum_i w_i * out_i with w_i = exp(lse_i - logsumexp_i(lse_i)).
+    Streaming-softmax identity — exact up to float rounding."""
+    outs = jnp.stack([o for o, _ in parts])                # [N, B, T, H, D]
+    lses = jnp.stack([l for _, l in parts])                # [N, B, H, T]
+    lse_tot = jax.nn.logsumexp(lses, axis=0)               # [B, H, T]
+    w = jnp.exp(lses - lse_tot[None])                      # [N, B, H, T]
+    w = jnp.moveaxis(w, 3, 2)[..., None]                   # [N, B, T, H, 1]
+    return jnp.sum(outs.astype(jnp.float32) * w, axis=0).astype(outs.dtype)
 
 
 # VMEM ceiling note: each grid program copies the full [t_pad, D] K and V

@@ -227,3 +227,71 @@ class TestChunkedBackward:
             for a, b in zip(gc, gd):
                 np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                            rtol=1e-4, atol=2e-4)
+
+
+class TestBlockMerge:
+    """flash_attention_block + merge_attention_blocks: the chunked/ring
+    building block (forward-only, absolute position offsets)."""
+
+    def test_two_chunk_merge_equals_full(self):
+        from deeplearning4j_tpu.ops.flash_attention import (
+            flash_attention_block, merge_attention_blocks)
+
+        rs = np.random.RandomState(0)
+        B, T, H, D = 2, 64, 2, 16
+        q, k, v = _qkv(rs, B, T, H, D)
+        half = T // 2
+        p0 = flash_attention_block(q, k[:, :half], v[:, :half],
+                                   q_offset=0, k_offset=0,
+                                   block_q=16, block_k=16, interpret=True)
+        p1 = flash_attention_block(q, k[:, half:], v[:, half:],
+                                   q_offset=0, k_offset=half,
+                                   block_q=16, block_k=16, interpret=True)
+        merged = merge_attention_blocks([p0, p1])
+        ref = _reference(q, k, v, False)
+        np.testing.assert_allclose(np.asarray(merged), np.asarray(ref),
+                                   rtol=1e-5, atol=2e-5)
+
+    def test_causal_offsets_ring_style(self):
+        """The second sequence shard's queries (absolute offset T0) attend
+        chunk 0 fully and chunk 1 causally — merged result equals the
+        corresponding rows of full causal attention."""
+        from deeplearning4j_tpu.ops.flash_attention import (
+            flash_attention_block, merge_attention_blocks)
+
+        rs = np.random.RandomState(1)
+        B, T, H, D = 2, 64, 2, 16
+        q, k, v = _qkv(rs, B, T, H, D)
+        half = T // 2
+        q1 = q[:, half:]
+        p0 = flash_attention_block(q1, k[:, :half], v[:, :half],
+                                   q_offset=half, k_offset=0, causal=True,
+                                   block_q=16, block_k=16, interpret=True)
+        p1 = flash_attention_block(q1, k[:, half:], v[:, half:],
+                                   q_offset=half, k_offset=half, causal=True,
+                                   block_q=16, block_k=16, interpret=True)
+        merged = merge_attention_blocks([p0, p1])
+        ref = _reference(q, k, v, True)[:, half:]
+        np.testing.assert_allclose(np.asarray(merged), np.asarray(ref),
+                                   rtol=1e-5, atol=2e-5)
+
+    def test_fully_masked_chunk_vanishes(self):
+        """Causal q at offset 0 sees nothing of a future k chunk: its lse is
+        ~-1e30 so the merge weight underflows to zero, no NaNs."""
+        from deeplearning4j_tpu.ops.flash_attention import (
+            flash_attention_block, merge_attention_blocks)
+
+        rs = np.random.RandomState(2)
+        B, T, H, D = 1, 32, 2, 16
+        q, k, v = _qkv(rs, B, T, H, D)
+        p_own = flash_attention_block(q, k, v, q_offset=0, k_offset=0,
+                                      causal=True, block_q=16, block_k=16,
+                                      interpret=True)
+        p_future = flash_attention_block(q, k, v, q_offset=0, k_offset=T,
+                                         causal=True, block_q=16, block_k=16,
+                                         interpret=True)
+        merged = merge_attention_blocks([p_own, p_future])
+        ref = _reference(q, k, v, True)
+        assert np.all(np.isfinite(np.asarray(merged, np.float32)))
+        np.testing.assert_allclose(np.asarray(merged), np.asarray(ref),
+                                   rtol=1e-5, atol=2e-5)
