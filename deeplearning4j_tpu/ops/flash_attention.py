@@ -133,6 +133,13 @@ def _block_sizes(T, block_q, block_k):
     return bq, bk, t_pad
 
 
+def _block_sizes2(Tq, Tk, block_q, block_k):
+    """Independent q/k lengths (chunked blocks): (bq, bk, q_pad, k_pad)."""
+    bq = min(block_q, max(Tq, 1))
+    bk = min(block_k, max(Tk, 1))
+    return bq, bk, _cdiv(Tq, bq) * bq, _cdiv(Tk, bk) * bk
+
+
 def _fwd_pallas_call(qt, kt, vt, *, D, bq, bk, q_pad, k_pad, t_real_k,
                      causal, scale, q_off, k_off, interpret, dtype):
     """The shared forward pallas_call (main path and chunked-block path):
@@ -182,35 +189,41 @@ def _flash_raw(q, k, v, causal: bool, block_q: int, block_k: int,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, block_q: int, block_k: int, t_real: int, t_pad: int,
-                   causal: bool, scale: float):
+                   *, block_q: int, block_k: int, t_real_q: int,
+                   t_real_k: int, k_pad: int, causal: bool, scale: float,
+                   q_off: int = 0, k_off: int = 0):
     """dq for one q-block: dq = scale * sum_k [p * (do@v^T - delta)] @ k,
-    p = exp(q@k^T*scale - lse) (FlashAttention-2 backward, eq. dS)."""
+    p = exp(q@k^T*scale - lse) (FlashAttention-2 backward, eq. dS).
+    ``delta`` may already carry the -dlse shift (differentiable-lse path:
+    ds = p * (dp - delta + dlse)). Validity masks use LOCAL positions vs
+    t_real_q/t_real_k; the causal comparison uses ABSOLUTE positions
+    (q_off/k_off — chunked/ring blocks)."""
     qi = pl.program_id(1)
     q = q_ref[0]                                                 # [bq, D]
     do = do_ref[0]                                               # [bq, D]
     lse = lse_ref[0].reshape(block_q, 1)                         # row -> col
     delta = delta_ref[0].reshape(block_q, 1)
-    q_pos = qi * block_q + lax.broadcasted_iota(
+    q_loc = qi * block_q + lax.broadcasted_iota(
         jnp.int32, (block_q, 1), 0)
-    q_valid = q_pos < t_real
+    q_valid = q_loc < t_real_q
 
     def body(kb, dq):
         k = k_ref[0, pl.ds(kb * block_k, block_k), :]
         v = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-        k_pos = kb * block_k + lax.broadcasted_iota(
+        k_loc = kb * block_k + lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1)
-        valid = jnp.logical_and(k_pos < t_real, q_valid)
+        valid = jnp.logical_and(k_loc < t_real_k, q_valid)
         if causal:
-            valid = jnp.logical_and(valid, k_pos <= q_pos)
+            valid = jnp.logical_and(valid,
+                                    k_off + k_loc <= q_off + q_loc)
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)              # [bq, bk]
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = (p * (dp - delta)).astype(k.dtype)
         return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
-    n_kb = t_pad // block_k
-    if causal:
+    n_kb = k_pad // block_k
+    if causal and q_off == k_off:
         n_kb = jnp.minimum(n_kb, (qi + 1) * block_q // block_k
                            + (1 if block_q % block_k else 0))
     dq0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
@@ -220,15 +233,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, block_q: int, block_k: int,
-                    t_real: int, t_pad: int, causal: bool, scale: float):
+                    t_real_q: int, t_real_k: int, q_pad: int, causal: bool,
+                    scale: float, q_off: int = 0, k_off: int = 0):
     """dk/dv for one k-block, looping over q-blocks:
-    dv = sum_q p^T @ do;  dk = scale * sum_q [p*(do@v^T - delta)]^T @ q."""
+    dv = sum_q p^T @ do;  dk = scale * sum_q [p*(do@v^T - delta)]^T @ q.
+    Same delta/offset semantics as _bwd_dq_kernel."""
     ki = pl.program_id(1)
     k = k_ref[0]                                                 # [bk, D]
     v = v_ref[0]
-    k_pos = ki * block_k + lax.broadcasted_iota(
+    k_loc = ki * block_k + lax.broadcasted_iota(
         jnp.int32, (1, block_k), 1)                              # [1, bk]
-    k_valid = k_pos < t_real
+    k_valid = k_loc < t_real_k
 
     def body(qb, carry):
         dk, dv = carry
@@ -239,11 +254,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, :, pl.ds(qb * block_q, block_q)].reshape(
             block_q, 1)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-        q_pos = qb * block_q + lax.broadcasted_iota(
+        q_loc = qb * block_q + lax.broadcasted_iota(
             jnp.int32, (block_q, 1), 0)
-        valid = jnp.logical_and(k_valid, q_pos < t_real)
+        valid = jnp.logical_and(k_valid, q_loc < t_real_q)
         if causal:
-            valid = jnp.logical_and(valid, k_pos <= q_pos)
+            valid = jnp.logical_and(valid,
+                                    k_off + k_loc <= q_off + q_loc)
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)              # [bq, bk]
         pc = p.astype(do.dtype)
         dv = dv + jnp.dot(pc.T, do, preferred_element_type=jnp.float32)
@@ -252,15 +268,83 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
         return dk, dv
 
-    n_qb = t_pad // block_q
+    n_qb = q_pad // block_q
     qb_start = 0
-    if causal:
+    if causal and q_off == k_off:
         # q blocks strictly above this k block's first row see none of it
         qb_start = (ki * block_k) // block_q
     zeros = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
     dk, dv = lax.fori_loop(qb_start, n_qb, body, (zeros, zeros))
     dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_pallas_calls(qt, kt, vt, dot, lse, delta, *, D, bq, bk, q_pad,
+                      k_pad, t_real_q, t_real_k, causal, scale, q_off,
+                      k_off, interpret, dtype):
+    """The two backward pallas_calls over padded [BH, ., D] arrays; returns
+    padded (dq, dk, dv). ``delta`` may already carry the -dlse shift."""
+    BH = qt.shape[0]
+    kw = {}
+    if _VMEM is not None and not interpret:
+        kw["memory_space"] = _VMEM
+    full = lambda bh, i: (bh, 0, 0)          # noqa: E731
+    blkq = lambda bh, i: (bh, i, 0)          # noqa: E731
+    row = lambda bh, i: (bh, 0, i)           # noqa: E731
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_q=bq, block_k=bk,
+                          t_real_q=t_real_q, t_real_k=t_real_k, k_pad=k_pad,
+                          causal=causal, scale=scale, q_off=q_off,
+                          k_off=k_off),
+        grid=(BH, q_pad // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), blkq, **kw),
+            pl.BlockSpec((1, k_pad, D), full, **kw),
+            pl.BlockSpec((1, k_pad, D), full, **kw),
+            pl.BlockSpec((1, bq, D), blkq, **kw),
+            pl.BlockSpec((1, 1, bq), row, **kw),
+            pl.BlockSpec((1, 1, bq), row, **kw),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), blkq, **kw),
+        out_shape=jax.ShapeDtypeStruct((BH, q_pad, D), dtype),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    blkk = lambda bh, i: (bh, i, 0)          # noqa: E731
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=bq, block_k=bk,
+                          t_real_q=t_real_q, t_real_k=t_real_k, q_pad=q_pad,
+                          causal=causal, scale=scale, q_off=q_off,
+                          k_off=k_off),
+        grid=(BH, k_pad // bk),
+        in_specs=[
+            pl.BlockSpec((1, q_pad, D), full, **kw),
+            pl.BlockSpec((1, bk, D), blkk, **kw),
+            pl.BlockSpec((1, bk, D), blkk, **kw),
+            pl.BlockSpec((1, q_pad, D), full, **kw),
+            pl.BlockSpec((1, 1, q_pad), full, **kw),
+            pl.BlockSpec((1, 1, q_pad), full, **kw),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), blkk, **kw),
+            pl.BlockSpec((1, bk, D), blkk, **kw),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, k_pad, D), dtype),
+            jax.ShapeDtypeStruct((BH, k_pad, D), dtype),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+    return dq, dk, dv
+
+
+def _row_layout(x2d, B, H, T, t_pad):
+    """[B, H, T] f32 -> padded [B*H, 1, t_pad] row layout."""
+    r = x2d.reshape(B * H, 1, T).astype(jnp.float32)
+    if t_pad != T:
+        r = jnp.pad(r, ((0, 0), (0, 0), (0, t_pad - T)))
+    return r
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, g, causal: bool, block_q: int,
@@ -275,58 +359,12 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal: bool, block_q: int,
     # delta_i = rowsum(do_i * o_i): cheap elementwise XLA, f32; same
     # [BH, 1, t_pad] row layout as lse
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-    delta = jnp.swapaxes(delta, 1, 2).reshape(B * H, 1, T)
-    if t_pad != T:
-        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, t_pad - T)))
+    delta = _row_layout(jnp.swapaxes(delta, 1, 2), B, H, T, t_pad)
 
-    kw = {}
-    if _VMEM is not None and not interpret:
-        kw["memory_space"] = _VMEM
-    full = lambda bh, i: (bh, 0, 0)          # noqa: E731
-    blkq = lambda bh, i: (bh, i, 0)          # noqa: E731
-    row = lambda bh, i: (bh, 0, i)           # noqa: E731
-
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, block_q=bq, block_k=bk,
-                          t_real=T, t_pad=t_pad, causal=causal, scale=scale),
-        grid=(B * H, t_pad // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, D), blkq, **kw),
-            pl.BlockSpec((1, t_pad, D), full, **kw),
-            pl.BlockSpec((1, t_pad, D), full, **kw),
-            pl.BlockSpec((1, bq, D), blkq, **kw),
-            pl.BlockSpec((1, 1, bq), row, **kw),
-            pl.BlockSpec((1, 1, bq), row, **kw),
-        ],
-        out_specs=pl.BlockSpec((1, bq, D), blkq, **kw),
-        out_shape=jax.ShapeDtypeStruct((B * H, t_pad, D), q.dtype),
-        interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
-
-    blkk = lambda bh, i: (bh, i, 0)          # noqa: E731
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, block_q=bq, block_k=bk,
-                          t_real=T, t_pad=t_pad, causal=causal, scale=scale),
-        grid=(B * H, t_pad // bk),
-        in_specs=[
-            pl.BlockSpec((1, t_pad, D), full, **kw),
-            pl.BlockSpec((1, bk, D), blkk, **kw),
-            pl.BlockSpec((1, bk, D), blkk, **kw),
-            pl.BlockSpec((1, t_pad, D), full, **kw),
-            pl.BlockSpec((1, 1, t_pad), full, **kw),
-            pl.BlockSpec((1, 1, t_pad), full, **kw),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, bk, D), blkk, **kw),
-            pl.BlockSpec((1, bk, D), blkk, **kw),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B * H, t_pad, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, t_pad, D), q.dtype),
-        ],
-        interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
-
+    dq, dk, dv = _bwd_pallas_calls(
+        qt, kt, vt, dot, lse, delta, D=D, bq=bq, bk=bk, q_pad=t_pad,
+        k_pad=t_pad, t_real_q=T, t_real_k=T, causal=causal, scale=scale,
+        q_off=0, k_off=0, interpret=interpret, dtype=q.dtype)
     return (_from_bh(dq, B, T, H), _from_bh(dk, B, T, H),
             _from_bh(dv, B, T, H))
 
@@ -449,10 +487,7 @@ def flash_attention_block(q, k, v, *, q_offset: int = 0, k_offset: int = 0,
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale = 1.0 / (D ** 0.5)
-    bq = min(block_q, max(Tq, 1))
-    bk = min(block_k, max(Tk, 1))
-    q_pad = _cdiv(Tq, bq) * bq
-    k_pad = _cdiv(Tk, bk) * bk
+    bq, bk, q_pad, k_pad = _block_sizes2(Tq, Tk, block_q, block_k)
     qt = _pad_bh(q, q_pad)
     kt, vt = _pad_bh(k, k_pad), _pad_bh(v, k_pad)
     # t_real_k gates KEY validity (Tk, not Tq — the chunk may be shorter);
@@ -466,6 +501,66 @@ def flash_attention_block(q, k, v, *, q_offset: int = 0, k_offset: int = 0,
     # docstring — only the weighted combination is meaningful)
     lse_b = lse[:, 0, :Tq].reshape(B, H, Tq)
     return _from_bh(out, B, Tq, H), lse_b
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_block_diff(q, k, v, q_offset, k_offset, causal, block_q, block_k,
+                      interpret):
+    return flash_attention_block(
+        q, k, v, q_offset=q_offset, k_offset=k_offset, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret)
+
+
+def _flash_block_diff_fwd(q, k, v, q_offset, k_offset, causal, block_q,
+                          block_k, interpret):
+    out, lse = flash_attention_block(
+        q, k, v, q_offset=q_offset, k_offset=k_offset, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_block_diff_bwd(q_offset, k_offset, causal, block_q, block_k,
+                          interpret, res, cts):
+    """Backward with BOTH cotangents (do, dlse). d lse_i/d s_ij = p_ij, so
+    the dlse contribution folds into the delta shift:
+    ds = p * (do@v^T - delta + dlse)  =>  delta_eff = delta - dlse
+    (FlashAttention-2 eq. dS extended for a differentiable logsumexp —
+    exactly what chunk-merged/ring attention training needs)."""
+    q, k, v, o, lse = res
+    do, dlse = cts
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = 1.0 / (D ** 0.5)
+    bq, bk, q_pad, k_pad = _block_sizes2(Tq, Tk, block_q, block_k)
+    qt, dot = _pad_bh(q, q_pad), _pad_bh(do, q_pad)
+    kt, vt = _pad_bh(k, k_pad), _pad_bh(v, k_pad)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.swapaxes(delta, 1, 2) - dlse.astype(jnp.float32)  # [B,H,Tq]
+    delta = _row_layout(delta, B, H, Tq, q_pad)
+    lse_r = _row_layout(lse, B, H, Tq, q_pad)
+    dq, dk, dv = _bwd_pallas_calls(
+        qt, kt, vt, dot, lse_r, delta, D=D, bq=bq, bk=bk, q_pad=q_pad,
+        k_pad=k_pad, t_real_q=Tq, t_real_k=Tk, causal=causal, scale=scale,
+        q_off=q_offset, k_off=k_offset, interpret=interpret, dtype=q.dtype)
+    return (_from_bh(dq, B, Tq, H), _from_bh(dk, B, Tk, H),
+            _from_bh(dv, B, Tk, H))
+
+
+_flash_block_diff.defvjp(_flash_block_diff_fwd, _flash_block_diff_bwd)
+
+
+def flash_attention_block_grad(q, k, v, *, q_offset: int = 0,
+                               k_offset: int = 0, causal: bool = False,
+                               block_q: int = 128, block_k: int = 128,
+                               interpret: bool = False):
+    """DIFFERENTIABLE chunked flash attention: like
+    :func:`flash_attention_block` but (out, lse) both carry gradients —
+    the merge (and anything downstream of it) backpropagates exactly
+    through every chunk via blockwise Pallas kernels. This is the
+    training-capable building block for chunk-sequential and ring
+    attention schedules."""
+    return _flash_block_diff(q, k, v, q_offset, k_offset, causal,
+                             block_q, block_k, interpret)
 
 
 def merge_attention_blocks(parts):
