@@ -108,8 +108,15 @@ class MultiHeadAttention(LayerConfig):
                     and q.shape[2] % mesh.shape["model"] == 0)
                 else None
             )
+            # flash-backed ring (Pallas chunk kernels + exact lse merge) on
+            # TPU for unmasked attention, same policy as the single-chip
+            # flash gate; forced use_flash=True engages it anywhere
+            on_tpu = jax.default_backend() == "tpu"
+            ring_flash = kmask is None and (
+                self.use_flash is True or (self.use_flash == "auto" and on_tpu))
             return ring_self_attention(
-                q, k, v, mesh, causal=self.causal, kmask=kmask, head_axis=head_axis
+                q, k, v, mesh, causal=self.causal, kmask=kmask,
+                head_axis=head_axis, use_flash=ring_flash
             )
         if kmask is None and self.use_flash in ("auto", True):
             from deeplearning4j_tpu.ops.flash_attention import flash_attention
