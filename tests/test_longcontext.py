@@ -294,3 +294,17 @@ class TestFlashRingAttention:
         a = ring_self_attention(q, k, v, mesh, causal=True, use_flash=True)
         b = ring_self_attention(q, k, v, mesh, causal=True, use_flash=False)
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+    def test_bf16_matches_local(self):
+        mesh = _mesh(data=2, seq=4)
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(3), 3)
+        B, T, H, D = 2, 32, 2, 8
+        q = jax.random.normal(k1, (B, T, H, D)).astype(jnp.bfloat16)
+        k = jax.random.normal(k2, (B, T, H, D)).astype(jnp.bfloat16)
+        v = jax.random.normal(k3, (B, T, H, D)).astype(jnp.bfloat16)
+        out = ring_self_attention(q, k, v, mesh, causal=True, use_flash=True)
+        ref = local_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                              v.astype(jnp.float32), causal=True)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref), rtol=3e-2, atol=3e-2)
