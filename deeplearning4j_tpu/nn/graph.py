@@ -34,7 +34,8 @@ import numpy as np
 
 from deeplearning4j_tpu.nn.config import LayerConfig, layer_from_dict, _encode_value
 from deeplearning4j_tpu.nn.input_type import InputType
-from deeplearning4j_tpu.nn.model import _cast_input
+from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrent
+from deeplearning4j_tpu.nn.model import _cast_input, _cast_labels
 from deeplearning4j_tpu.nn.preprocessors import infer_preprocessor
 from deeplearning4j_tpu.train.updaters import (
     apply_gradient_normalization,
@@ -402,6 +403,12 @@ class ComputationGraphConfiguration:
     seed: int = 12345
     updater: Any = "sgd"
     dtype: str = "float32"
+    # Truncated BPTT over the DAG (ComputationGraph.java:950,1179
+    # doTruncatedBPTT): "standard" | "tbptt". Forward/backward chunk length
+    # unified, like the MLN path.
+    backprop_type: str = "standard"
+    tbptt_fwd_length: int = 20
+    tbptt_back_length: int = 20
 
     # -- serde -------------------------------------------------------------
     def to_dict(self) -> dict:
@@ -422,6 +429,9 @@ class ComputationGraphConfiguration:
             "seed": self.seed,
             "updater": _encode_value(self.updater),
             "dtype": self.dtype,
+            "backprop_type": self.backprop_type,
+            "tbptt_fwd_length": self.tbptt_fwd_length,
+            "tbptt_back_length": self.tbptt_back_length,
         }
 
     def to_json(self, **kw) -> str:
@@ -446,6 +456,9 @@ class ComputationGraphConfiguration:
             seed=d.get("seed", 12345),
             updater=d.get("updater", "sgd"),
             dtype=d.get("dtype", "float32"),
+            backprop_type=d.get("backprop_type", "standard"),
+            tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
+            tbptt_back_length=d.get("tbptt_back_length", 20),
         )
 
     @staticmethod
@@ -480,6 +493,8 @@ class GraphBuilder:
         self._seed = 12345
         self._updater: Any = "sgd"
         self._dtype = "float32"
+        self._backprop_type = "standard"
+        self._tbptt_length = 20
 
     def add_inputs(self, *names: str) -> "GraphBuilder":
         self._inputs.extend(names)
@@ -520,6 +535,13 @@ class GraphBuilder:
         self._dtype = d
         return self
 
+    def tbptt(self, length: int) -> "GraphBuilder":
+        """Enable truncated BPTT with the given chunk length
+        (GraphBuilder.backpropType(TruncatedBPTT) + tBPTT{Forward,Backward}Length)."""
+        self._backprop_type = "tbptt"
+        self._tbptt_length = length
+        return self
+
     def build(self) -> ComputationGraphConfiguration:
         if not self._inputs:
             raise ValueError("ComputationGraph needs at least one input")
@@ -538,6 +560,9 @@ class GraphBuilder:
             seed=self._seed,
             updater=self._updater,
             dtype=self._dtype,
+            backprop_type=self._backprop_type,
+            tbptt_fwd_length=self._tbptt_length,
+            tbptt_back_length=self._tbptt_length,
         )
 
 
@@ -597,7 +622,9 @@ class ComputationGraph:
         self.epoch = 0
         self._rng = jax.random.PRNGKey(conf.seed)
         self._step_fn = None
+        self._tbptt_step_fn = None
         self._output_fn = None
+        self._rnn_carries: Optional[dict] = None
         self.listeners: list = []
 
     # -- resolution --------------------------------------------------------
@@ -629,6 +656,24 @@ class ComputationGraph:
             )
         self.vertex_types = types
         self.output_types = [types[o] for o in conf.outputs]
+        # layer vertices with a time-stepped carry: tBPTT chunking and
+        # rnnTimeStep streaming thread state through exactly these
+        # (ComputationGraph.java rnnActivateUsingStoredState:1334)
+        self._carry_vertices = [
+            name for name in self.topo_order
+            if self.rt[name].spec.is_layer()
+            and isinstance(self.rt[name].config, BaseRecurrent)
+            and getattr(self.rt[name].config, "SUPPORTS_CARRY", False)
+        ]
+        # wrapper layers holding an inner RNN (Bidirectional, MaskZero,
+        # LastTimeStep): no carry channel — streaming/tBPTT would silently
+        # reset their inner state every call, so those paths refuse them
+        # (the reference's Bidirectional rnnTimeStep likewise throws)
+        self._wrapped_rnn_vertices = [
+            name for name in self.topo_order
+            if self.rt[name].spec.is_layer()
+            and getattr(self.rt[name].config, "rnn", None) is not None
+        ]
         self._loss_vertices = [
             o for o in conf.outputs if hasattr(self.rt[o].config, "score")
         ]
@@ -674,8 +719,8 @@ class ComputationGraph:
     # -- forward -----------------------------------------------------------
     def _forward(self, params, state, inputs: Dict[str, jax.Array], *, train, rngs,
                  masks: Optional[Dict[str, Any]] = None, stop_at: Optional[set] = None,
-                 collect: bool = False, ex_weight=None):
-        """Walk topo order. Returns (acts, new_state, mask_acts).
+                 collect: bool = False, ex_weight=None, carries: Optional[dict] = None):
+        """Walk topo order. Returns (acts, new_state, mask_acts, new_carries).
 
         ``stop_at``: vertex names whose activation should be the PRE-output
         value for loss heads — loss vertices are applied outside (score needs
@@ -684,11 +729,16 @@ class ComputationGraph:
         vertices declaring CONSUMES_EXAMPLE_WEIGHT (BatchNorm excludes
         zero-weighted ParallelWrapper padding rows from batch statistics —
         same channel as MultiLayerNetwork._forward).
+        ``carries``: {vertex_name: rnn carry} for the vertices in
+        self._carry_vertices — when given, recurrent layer vertices run
+        ``apply_seq`` from the supplied carry and the final carries are
+        returned (the doTruncatedBPTT / rnnActivateUsingStoredState channel).
         """
         acts: Dict[str, jax.Array] = dict(inputs)
         mask_acts: Dict[str, Any] = dict(masks or {})
         for n in self.conf.inputs:
             mask_acts.setdefault(n, None)
+        new_carries = dict(carries) if carries is not None else None
         new_state = {}
         for i, name in enumerate(self.topo_order):
             v = self.rt[name]
@@ -719,7 +769,12 @@ class ComputationGraph:
                     p_v = v.config.maybe_weight_noise(
                         p_v, train, jax.random.fold_in(rng, 0x5EED)
                     )
-                if ex_weight is not None and getattr(v.config, "CONSUMES_EXAMPLE_WEIGHT", False):
+                if new_carries is not None and name in new_carries:
+                    x2 = v.config.maybe_dropout_input(x, train, rng)
+                    y, c = v.config.apply_seq(p_v, x2, new_carries[name], m)
+                    new_carries[name] = c
+                    ns = state[name]
+                elif ex_weight is not None and getattr(v.config, "CONSUMES_EXAMPLE_WEIGHT", False):
                     y, ns = v.config.apply(p_v, state[name], x, train=train,
                                            rng=rng, mask=m, ex_weight=ex_weight)
                 else:
@@ -737,15 +792,15 @@ class ComputationGraph:
                 mask_acts[name] = v.config.propagate_mask(in_masks, v.input_types)
             acts[name] = y
             new_state[name] = ns
-        return acts, new_state, mask_acts
+        return acts, new_state, mask_acts, new_carries
 
     # -- loss --------------------------------------------------------------
     def _loss(self, params, state, inputs, labels, fmasks, lmasks, rngs, train=True,
-              ex_weight=None):
+              ex_weight=None, carries=None):
         stop = set(self._loss_vertices)
-        acts, new_state, mask_acts = self._forward(
+        acts, new_state, mask_acts, new_carries = self._forward(
             params, state, inputs, train=train, rngs=rngs, masks=fmasks, stop_at=stop,
-            ex_weight=ex_weight,
+            ex_weight=ex_weight, carries=carries,
         )
         total = jnp.asarray(0.0, jnp.float32)
         for i, oname in enumerate(self.conf.outputs):
@@ -762,22 +817,24 @@ class ComputationGraph:
         for name in self.topo_order:
             v = self.rt[name]
             total = total + v.config.regularization_penalty(params[name])
-        return total, new_state
+        return total, (new_state, new_carries)
 
     # -- jitted step -------------------------------------------------------
-    def _make_step(self):
+    def _make_step(self, with_carries: bool = False):
         order = self.topo_order
         updaters = self._updaters
 
         def step(params, opt_state, state, it, rng, inputs, labels, fmasks, lmasks,
-                 ex_weight=None):
+                 carries, ex_weight=None):
             rngs = list(jax.random.split(rng, len(order)))
 
             def loss_fn(p):
                 return self._loss(p, state, inputs, labels, fmasks, lmasks, rngs,
-                                  ex_weight=ex_weight)
+                                  ex_weight=ex_weight,
+                                  carries=carries if with_carries else None)
 
-            (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            ((loss, (new_state, new_carries)), grads) = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
             new_params, new_opt = {}, {}
             for name in order:
                 g = grads[name]
@@ -801,9 +858,37 @@ class ComputationGraph:
                     p_new = apply_constraints(cfg, p_new)
                 new_params[name] = p_new
                 new_opt[name] = ns
-            return new_params, new_opt, new_state, loss
+            return new_params, new_opt, new_state, new_carries, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _get_step_fn(self, with_carries: bool):
+        if with_carries:
+            if self._tbptt_step_fn is None:
+                self._tbptt_step_fn = self._make_step(True)
+            return self._tbptt_step_fn
+        if self._step_fn is None:
+            self._step_fn = self._make_step(False)
+        return self._step_fn
+
+    def _initial_carries(self, batch: int) -> dict:
+        if self._wrapped_rnn_vertices:
+            raise NotImplementedError(
+                "tBPTT / rnn_time_step cannot thread state through wrapper "
+                f"RNN vertices {self._wrapped_rnn_vertices}: their inner RNN "
+                "has no carry channel and would silently reset each chunk. "
+                "Use the bare recurrent layer, or full-sequence calls.")
+        return {
+            name: self.rt[name].config.initial_carry(batch, self.dtype)
+            for name in self._carry_vertices
+        }
+
+    def _time_distributed_inputs(self):
+        """Input names whose InputType is recurrent — the time axis to chunk
+        in tBPTT, decided from the declared types, not array rank (2-D
+        integer token-id sequences are time-distributed too)."""
+        return [n for n in self.conf.inputs
+                if self.conf.input_types[n].kind == "recurrent"]
 
     # -- data normalization ------------------------------------------------
     def _norm_multi(self, v, n) -> Optional[Tuple]:
@@ -861,8 +946,13 @@ class ComputationGraph:
             for l in self.listeners:
                 l.on_epoch_start(self, self.epoch)
             source = data() if callable(data) else data
+            tbptt = (self.conf.backprop_type == "tbptt"
+                     and bool(self._time_distributed_inputs()))
             for batch in self._iter_multi(source, batch_size):
-                score = self.fit_batch(batch)
+                if tbptt:
+                    score = self._fit_tbptt(*batch)
+                else:
+                    score = self.fit_batch(batch)
                 if self.listeners:
                     score = float(score)
                     bs = len(jax.tree_util.tree_leaves(batch[0])[0])
@@ -923,16 +1013,71 @@ class ComputationGraph:
             f, l, fm, lm = batch
         else:
             f, l, fm, lm = self._as_multi_batch(batch)
-        if self._step_fn is None:
-            self._step_fn = self._make_step()
-        self.params, self.opt_state, self.state, loss = self._step_fn(
+        step = self._get_step_fn(False)
+        self.params, self.opt_state, self.state, _, loss = step(
             self.params, self.opt_state, self.state,
             jnp.asarray(self.iteration, jnp.int32), self._next_rng(),
-            self._input_dict(f), l, self._mask_dict(fm), lm,
+            self._input_dict(f), l, self._mask_dict(fm), lm, {},
             ex_weight=jnp.asarray(ew, self.dtype) if ew is not None else None,
         )
         self.iteration += 1
         return loss
+
+    def _fit_tbptt(self, f, l, fm, lm):
+        """Truncated BPTT over the DAG (ComputationGraph.java:950,1179
+        doTruncatedBPTT): chunk the time axis of every recurrent input (and
+        time-distributed labels/masks), carry RNN-vertex state across chunks
+        with stopped gradients. Static ([B,F]) inputs are re-fed whole to
+        every chunk — the DuplicateToTimeSeriesVertex use case."""
+        step = self._get_step_fn(True)
+        td_inputs = set(self._time_distributed_inputs())
+        T = max(x.shape[1] for n, x in zip(self.conf.inputs, f) if n in td_inputs)
+        L = self.conf.tbptt_fwd_length
+        B = f[0].shape[0]
+        carries = self._initial_carries(B)
+
+        def slice_t(x, sl, kind):
+            # feat: inputs DECLARED recurrent chunk on axis 1 — [B,T,F]
+            # float streams and [B,T] integer token-id streams alike
+            # (kind=="feat_td"); statics pass whole. label: [B,T,C] one-hot
+            # or [B,T] sparse-integer. mask: [B,T].
+            if x is None:
+                return None
+            nd = np.ndim(x)
+            if nd == 3 and x.shape[1] == T:
+                return x[:, sl]
+            if nd == 2 and x.shape[1] == T:
+                if kind in ("mask", "feat_td") or (
+                        kind == "label" and np.asarray(x).dtype.kind in "iu"):
+                    return x[:, sl]
+            return x
+
+        total, nchunks = 0.0, 0
+        for t0 in range(0, T, L):
+            sl = slice(t0, min(t0 + L, T))
+            fc = tuple(
+                _cast_input(slice_t(x, sl, "feat_td" if n in td_inputs else "feat"),
+                            self.dtype)
+                for n, x in zip(self.conf.inputs, f))
+            lc = tuple(_cast_labels(slice_t(y, sl, "label"), self.dtype)
+                       for y in l) if l is not None else None
+            fmc = tuple(jnp.asarray(slice_t(m, sl, "mask"), self.dtype)
+                        if m is not None else None
+                        for m in fm) if fm is not None else None
+            lmc = tuple(jnp.asarray(slice_t(m, sl, "mask"), self.dtype)
+                        if m is not None else None
+                        for m in lm) if lm is not None else None
+            self.params, self.opt_state, self.state, carries, loss = step(
+                self.params, self.opt_state, self.state,
+                jnp.asarray(self.iteration, jnp.int32), self._next_rng(),
+                self._input_dict(fc), lc, self._mask_dict(fmc), lmc, carries,
+            )
+            # truncation is structural: each chunk is its own jitted step, so
+            # the concrete carry arrays carry values, never gradients
+            total = total + loss
+            nchunks += 1
+            self.iteration += 1
+        return total / max(nchunks, 1)
 
     def _next_rng(self):
         self._rng, k = jax.random.split(self._rng)
@@ -948,14 +1093,53 @@ class ComputationGraph:
         fm = self._norm_multi(fmasks, len(self.conf.inputs)) if fmasks is not None else None
         if self._output_fn is None:
             def fwd(params, state, inputs, masks):
-                acts, _, _ = self._forward(params, state, inputs, train=False,
-                                           rngs=None, masks=masks)
+                acts, _, _, _ = self._forward(params, state, inputs, train=False,
+                                              rngs=None, masks=masks)
                 return tuple(acts[o] for o in self.conf.outputs)
 
             self._output_fn = jax.jit(fwd)
         outs = self._output_fn(self.params, self.state, self._input_dict(feats),
                                self._mask_dict(fm))
         return outs[0] if len(outs) == 1 else outs
+
+    # -- streaming RNN inference (ComputationGraph.rnnTimeStep:2718) -------
+    def rnn_time_step(self, *xs):
+        """Feed one or more timesteps per recurrent input, carrying RNN-vertex
+        state between calls (rnnTimeStep:2718-2800 /
+        rnnActivateUsingStoredState:1334). A 2-D array for a recurrent input
+        means a single timestep; outputs are squeezed back to 2-D in that
+        case. Static inputs pass [B,F] unchanged."""
+        if len(xs) == 1 and isinstance(xs[0], (tuple, list)):
+            xs = tuple(xs[0])
+        feats, squeeze = [], False
+        for name, x in zip(self.conf.inputs, xs):
+            x = _cast_input(x, self.dtype)
+            if self.conf.input_types[name].kind == "recurrent":
+                if jnp.issubdtype(x.dtype, jnp.integer):
+                    # token-id stream: full input is [B,T]; [B] = one step
+                    if x.ndim == 1:
+                        x = x[:, None]
+                        squeeze = True
+                elif x.ndim == 2:
+                    x = x[:, None, :]
+                    squeeze = True
+            feats.append(x)
+        B = feats[0].shape[0]
+        leaves = (jax.tree_util.tree_leaves(self._rnn_carries)
+                  if self._rnn_carries is not None else [])
+        if self._rnn_carries is None or (leaves and leaves[0].shape[0] != B):
+            self._rnn_carries = self._initial_carries(B)
+        acts, _, _, self._rnn_carries = self._forward(
+            self.params, self.state, self._input_dict(tuple(feats)),
+            train=False, rngs=None, carries=self._rnn_carries)
+        outs = tuple(
+            a[:, 0, :] if squeeze and a.ndim == 3 and a.shape[1] == 1 else a
+            for a in (acts[o] for o in self.conf.outputs)
+        )
+        return outs[0] if len(outs) == 1 else outs
+
+    def rnn_clear_previous_state(self):
+        self._rnn_carries = None
 
     def score(self, batch) -> float:
         f, l, fm, lm = self._as_multi_batch(batch)

@@ -495,8 +495,8 @@ class MultiLayerNetwork:
                 jnp.asarray(self.iteration, jnp.int32), self._next_rng(),
                 xc, yc, fmc, lmc, carries,
             )
-            # carries cross chunk boundaries without gradient flow (truncation)
-            carries = jax.tree_util.tree_map(jax.lax.stop_gradient, carries)
+            # truncation is structural: each chunk is its own jitted step, so
+            # the concrete carry arrays carry values, never gradients
             total = total + loss  # device-side accumulation, no host sync
             nchunks += 1
             self.iteration += 1

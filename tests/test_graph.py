@@ -368,7 +368,7 @@ class TestReviewRegressions:
         model = ComputationGraph(conf).init()
         x = rng.randn(2, 5, 4).astype(np.float32)
         mask = np.array([[1, 1, 0, 0, 0], [1, 1, 1, 1, 1]], np.float32)
-        acts, _, _ = model._forward(
+        acts, _, _, _ = model._forward(
             model.params, model.state, {"in": jnp.asarray(x)},
             train=False, rngs=None, masks={"in": jnp.asarray(mask)},
         )
@@ -387,3 +387,153 @@ class TestClone:
         out0 = np.asarray(c.output(x))
         model.fit((x, y), epochs=3)
         np.testing.assert_allclose(np.asarray(c.output(x)), out0, rtol=1e-6)
+
+
+class TestGraphTbptt:
+    """CG truncated BPTT + stored-state streaming
+    (ComputationGraph.java:950,1179 doTruncatedBPTT, rnnTimeStep:2718-2800)."""
+
+    @staticmethod
+    def _multi_input_rnn(tbptt_len=None, t=12, updater="sgd"):
+        """Multi-input RNN DAG: recurrent input + static input duplicated to
+        the time axis, merged, LSTM, time-distributed head."""
+        b = (
+            ComputationGraphConfiguration.builder()
+            .add_inputs("seq", "static")
+            .set_input_types(InputType.recurrent(3, t), InputType.feed_forward(4))
+            .add_vertex("dup", DuplicateToTimeSeriesVertex(), "static", "seq")
+            .add_vertex("merged", MergeVertex(), "seq", "dup")
+            .add_layer("lstm", LSTM(n_out=6), "merged")
+            .add_layer("out", RnnOutputLayer(n_out=2, activation="softmax"), "lstm")
+            .set_outputs("out")
+            .updater(updater)
+        )
+        if tbptt_len is not None:
+            b.tbptt(tbptt_len)
+        return b.build()
+
+    @staticmethod
+    def _seq_batch(rng, n=6, t=12):
+        xs = rng.randn(n, t, 3).astype(np.float32)
+        st = rng.randn(n, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, (n, t))]
+        return xs, st, y
+
+    def test_tbptt_single_chunk_equals_standard(self, rng):
+        """One chunk spanning the whole sequence == the standard step."""
+        xs, st, y = self._seq_batch(rng)
+        m_std = ComputationGraph(self._multi_input_rnn(None)).init()
+        m_tb = ComputationGraph(self._multi_input_rnn(12)).init()
+        m_std.fit(((xs, st), y))
+        m_tb.fit(((xs, st), y))
+        for name in m_std.params:
+            for k in m_std.params[name]:
+                np.testing.assert_allclose(
+                    np.asarray(m_std.params[name][k]),
+                    np.asarray(m_tb.params[name][k]), rtol=2e-5, atol=1e-6)
+
+    def test_tbptt_chunked_runs_and_carries(self, rng):
+        """Chunked tBPTT trains the DAG: 12 steps / 4 per chunk = 3 its."""
+        xs, st, y = self._seq_batch(rng)
+        m = ComputationGraph(self._multi_input_rnn(4, updater={"type": "adam", "lr": 0.01})).init()
+        s0 = m.score(((xs, st), y))
+        m.fit(((xs, st), y), epochs=4)
+        assert m.iteration == 12
+        assert m.score(((xs, st), y)) < s0
+
+    def test_tbptt_carry_matters(self, rng):
+        """The carry crosses chunk boundaries: chunked tBPTT must differ from
+        training on independently-reset chunks (state threading is real)."""
+        xs, st, y = self._seq_batch(rng)
+        m_tb = ComputationGraph(self._multi_input_rnn(4)).init()
+        m_reset = ComputationGraph(self._multi_input_rnn(None)).init()
+        m_tb.fit(((xs, st), y))
+        for t0 in range(0, 12, 4):
+            sl = slice(t0, t0 + 4)
+            m_reset.fit(((xs[:, sl], st), y[:, sl]))
+        diffs = [
+            np.abs(np.asarray(m_tb.params[n][k]) - np.asarray(m_reset.params[n][k])).max()
+            for n in m_tb.params for k in m_tb.params[n]
+        ]
+        assert max(diffs) > 1e-6
+
+    def test_rnn_time_step_matches_full_forward(self, rng):
+        xs, st, _ = self._seq_batch(rng, n=4, t=6)
+        m = ComputationGraph(self._multi_input_rnn(None, t=6)).init()
+        full = np.asarray(m.output(xs, st))
+        m.rnn_clear_previous_state()
+        stepped = [
+            np.asarray(m.rnn_time_step(xs[:, t, :], st)) for t in range(6)
+        ]
+        np.testing.assert_allclose(full, np.stack(stepped, axis=1),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_rnn_time_step_multi_step_chunks(self, rng):
+        """Streaming in 2-step chunks equals the full forward too."""
+        xs, st, _ = self._seq_batch(rng, n=3, t=8)
+        m = ComputationGraph(self._multi_input_rnn(None, t=8)).init()
+        full = np.asarray(m.output(xs, st))
+        m.rnn_clear_previous_state()
+        outs = [np.asarray(m.rnn_time_step(xs[:, t0:t0 + 2], st))
+                for t0 in range(0, 8, 2)]
+        np.testing.assert_allclose(full, np.concatenate(outs, axis=1),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_clear_previous_state_resets(self, rng):
+        xs, st, _ = self._seq_batch(rng, n=2, t=4)
+        m = ComputationGraph(self._multi_input_rnn(None, t=4)).init()
+        a = np.asarray(m.rnn_time_step(xs[:, 0, :], st))
+        m.rnn_time_step(xs[:, 1, :], st)
+        m.rnn_clear_previous_state()
+        b = np.asarray(m.rnn_time_step(xs[:, 0, :], st))
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_tbptt_serde_round_trip(self):
+        conf = self._multi_input_rnn(5)
+        c2 = ComputationGraphConfiguration.from_json(conf.to_json())
+        assert c2.backprop_type == "tbptt"
+        assert c2.tbptt_fwd_length == 5
+
+    def test_tbptt_integer_token_input_chunks(self, rng):
+        """2-D integer token-id sequences chunk on the time axis too (the
+        EmbeddingSequence case — time-distributedness comes from the declared
+        InputType, not array rank)."""
+        from deeplearning4j_tpu.nn.layers.core import EmbeddingSequence
+
+        T = 8
+        conf = (
+            ComputationGraphConfiguration.builder()
+            .add_inputs("tok")
+            .set_input_types(InputType.recurrent(1, T))
+            .add_layer("emb", EmbeddingSequence(n_in=10, n_out=5), "tok")
+            .add_layer("lstm", LSTM(n_out=6), "emb")
+            .add_layer("out", RnnOutputLayer(n_out=2, activation="softmax"), "lstm")
+            .set_outputs("out")
+            .tbptt(4)
+            .build()
+        )
+        tok = rng.randint(0, 10, (4, T)).astype(np.int32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, (4, T))]
+        m = ComputationGraph(conf).init()
+        m.fit((tok, y))
+        assert m.iteration == T // 4  # chunked, not full-BPTT
+
+    def test_wrapped_rnn_refuses_streaming(self, rng):
+        """Wrapper RNN vertices (no carry channel) must refuse tBPTT /
+        rnn_time_step instead of silently resetting state each chunk."""
+        from deeplearning4j_tpu.nn.layers.recurrent import Bidirectional, SimpleRnn
+
+        T = 6
+        conf = (
+            ComputationGraphConfiguration.builder()
+            .add_inputs("seq")
+            .set_input_types(InputType.recurrent(3, T))
+            .add_layer("bi", Bidirectional(rnn=SimpleRnn(n_out=4)), "seq")
+            .add_layer("out", RnnOutputLayer(n_out=2, activation="softmax"), "bi")
+            .set_outputs("out")
+            .build()
+        )
+        m = ComputationGraph(conf).init()
+        x = rng.randn(2, T, 3).astype(np.float32)
+        with pytest.raises(NotImplementedError, match="wrapper"):
+            m.rnn_time_step(x[:, 0, :])
