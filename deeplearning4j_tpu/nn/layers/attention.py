@@ -70,8 +70,8 @@ class MultiHeadAttention(LayerConfig):
     attn_dropout: float = 0.0
     weight_init: Any = "xavier"
     # Pallas flash-attention policy (ops/flash_attention.py): "auto" uses
-    # the kernel on TPU for unmasked attention (the [T,T] scores never
-    # leave VMEM — at T=8192 the XLA path cannot even compile, PERF.md);
+    # the kernel on TPU — masked (kmask) or not; the [T,T] scores never
+    # leave VMEM (at T=8192 the XLA path cannot even compile, PERF.md).
     # True forces it everywhere (Pallas interpreter on CPU — slow, for
     # tests); False always uses the XLA einsum path.
     use_flash: Any = "auto"
@@ -109,23 +109,27 @@ class MultiHeadAttention(LayerConfig):
                 else None
             )
             # flash-backed ring (Pallas chunk kernels + exact lse merge) on
-            # TPU for unmasked attention, same policy as the single-chip
-            # flash gate; forced use_flash=True engages it anywhere
+            # TPU, same policy as the single-chip flash gate; forced
+            # use_flash=True engages it anywhere. kmask rides the ring
+            # with its k/v block (round 5 — padded batches keep the flash
+            # memory envelope).
             on_tpu = jax.default_backend() == "tpu"
-            ring_flash = kmask is None and (
+            ring_flash = (
                 self.use_flash is True or (self.use_flash == "auto" and on_tpu))
             return ring_self_attention(
                 q, k, v, mesh, causal=self.causal, kmask=kmask,
                 head_axis=head_axis, use_flash=ring_flash
             )
-        if kmask is None and self.use_flash in ("auto", True):
+        if self.use_flash in ("auto", True):
             from deeplearning4j_tpu.ops.flash_attention import flash_attention
 
             on_tpu = jax.default_backend() == "tpu"
             if self.use_flash is True or on_tpu:
                 # off-TPU (interpreter) the compiled XLA-remat backward is
-                # far faster than three interpreted Pallas kernels
-                return flash_attention(q, k, v, causal=self.causal,
+                # far faster than three interpreted Pallas kernels; kmask
+                # loads one [1, block_k] validity row per key block in-kernel
+                return flash_attention(q, k, v, kmask=kmask,
+                                       causal=self.causal,
                                        interpret=not on_tpu,
                                        bwd="pallas" if on_tpu else "xla")
         return local_attention(q, k, v, causal=self.causal, kmask=kmask)

@@ -20,7 +20,9 @@ as the correctness oracle and fallback.
 
 CPU/tests: ``interpret=True`` runs the identical kernel in the Pallas
 interpreter; the layer's default ("auto") uses the kernel only on TPU and
-falls back to the XLA path elsewhere and for masked (kmask) variants.
+falls back to the XLA path elsewhere. Key-validity masks (padded batches)
+run IN the kernel: a [B, T] kmask contributes one [1, block_k] row load
+per key block, ANDed into the causal/length validity mask (round 5).
 Attention dropout is applied to the attention OUTPUT (not the probability
 matrix) in both paths — see MultiHeadAttention.apply in
 nn/layers/attention.py — so dropout is flash-compatible and does not gate
@@ -52,11 +54,14 @@ def _cdiv(a: int, b: int) -> int:
     return -(-a // b)
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
+def _kernel(q_ref, k_ref, v_ref, *rest, block_q: int,
             block_k: int, t_real: int, t_pad: int, causal: bool,
-            scale: float, q_off: int = 0, k_off: int = 0):
+            scale: float, q_off: int = 0, k_off: int = 0,
+            has_kmask: bool = False):
     """One q-block vs all key blocks. Refs: q [1, block_q, D];
-    k/v [1, t_pad, D]; o [1, block_q, D]; lse [1, 1, block_q].
+    k/v [1, t_pad, D]; optional kmask [1, 1, t_pad] (row layout, per
+    BATCH — key validity, ANDed into ``valid``); o [1, block_q, D];
+    lse [1, 1, block_q].
 
     lse is stored as a ROW over a [BH, 1, t_pad] array: the natural
     column layout ([.., t_pad, 1]) lane-pads 128x on TPU, which as a
@@ -64,6 +69,10 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
     sublane-pads 8x. NOTE: zero-padded q rows get a real finite lse (they
     still see valid keys); the backward's q_valid mask — not any lse
     sentinel — is what keeps padded rows out of dk/dv."""
+    if has_kmask:
+        km_ref, o_ref, lse_ref = rest
+    else:
+        (o_ref, lse_ref), km_ref = rest, None
     qi = pl.program_id(1)
     # operands stay in their native dtype (bf16 keeps the MXU at full rate);
     # scores, softmax state and the accumulator are f32. q_off/k_off are
@@ -88,6 +97,9 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
         valid = k_pos < k_off + t_real
         if causal:
             valid = jnp.logical_and(valid, k_pos <= q_pos)
+        if km_ref is not None:
+            km = km_ref[0, :, pl.ds(kb * block_k, block_k)]      # [1, bk]
+            valid = jnp.logical_and(valid, km > 0)
         s = jnp.where(valid, s, _NEG_BIG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)                                   # [bq, bk] f32
@@ -141,25 +153,35 @@ def _block_sizes2(Tq, Tk, block_q, block_k):
 
 
 def _fwd_pallas_call(qt, kt, vt, *, D, bq, bk, q_pad, k_pad, t_real_k,
-                     causal, scale, q_off, k_off, interpret, dtype):
+                     causal, scale, q_off, k_off, interpret, dtype,
+                     kmask=None, H=1):
     """The shared forward pallas_call (main path and chunked-block path):
     padded [BH, q_pad, D] q and [BH, k_pad, D] k/v -> ([BH, q_pad, D] out,
-    [BH, 1, q_pad] row-layout lse)."""
+    [BH, 1, q_pad] row-layout lse). ``kmask``: optional [B, 1, k_pad] f32
+    key-validity rows, shared by the H heads of each batch (the grid's bh
+    axis maps to batch bh // H)."""
     BH = qt.shape[0]
     kernel = functools.partial(
         _kernel, block_q=bq, block_k=bk, t_real=t_real_k, t_pad=k_pad,
-        causal=causal, scale=scale, q_off=q_off, k_off=k_off)
+        causal=causal, scale=scale, q_off=q_off, k_off=k_off,
+        has_kmask=kmask is not None)
     kw = {}
     if _VMEM is not None and not interpret:
         kw["memory_space"] = _VMEM
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0), **kw),
+        pl.BlockSpec((1, k_pad, D), lambda bh, qi: (bh, 0, 0), **kw),
+        pl.BlockSpec((1, k_pad, D), lambda bh, qi: (bh, 0, 0), **kw),
+    ]
+    args = [qt, kt, vt]
+    if kmask is not None:
+        in_specs.append(
+            pl.BlockSpec((1, 1, k_pad), lambda bh, qi: (bh // H, 0, 0), **kw))
+        args.append(kmask)
     return pl.pallas_call(
         kernel,
         grid=(BH, q_pad // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0), **kw),
-            pl.BlockSpec((1, k_pad, D), lambda bh, qi: (bh, 0, 0), **kw),
-            pl.BlockSpec((1, k_pad, D), lambda bh, qi: (bh, 0, 0), **kw),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0), **kw),
             pl.BlockSpec((1, 1, bq), lambda bh, qi: (bh, 0, qi), **kw),
@@ -169,35 +191,51 @@ def _fwd_pallas_call(qt, kt, vt, *, D, bq, bk, q_pad, k_pad, t_real_k,
             jax.ShapeDtypeStruct((BH, 1, q_pad), jnp.float32),
         ],
         interpret=interpret,
-    )(qt, kt, vt)
+    )(*args)
 
 
-def _flash_raw(q, k, v, causal: bool, block_q: int, block_k: int,
+def _pad_km(kmask, k_pad):
+    """[B, Tk] key-validity -> [B, 1, k_pad] f32 rows (padding keys 0)."""
+    B, Tk = kmask.shape
+    km = kmask.astype(jnp.float32).reshape(B, 1, Tk)
+    if k_pad != Tk:
+        km = jnp.pad(km, ((0, 0), (0, 0), (0, k_pad - Tk)))
+    return km
+
+
+def _flash_raw(q, k, v, kmask, causal: bool, block_q: int, block_k: int,
                interpret: bool, with_lse: bool = False):
     """q/k/v: [B, T, H, D] -> [B, T, H, D] (plus the [B*H, 1, t_pad] row
-    logsumexp when ``with_lse``). Forward only."""
+    logsumexp when ``with_lse``). Forward only. ``kmask``: [B, T] key
+    validity or None."""
     B, T, H, D = q.shape
     scale = 1.0 / (D ** 0.5)
     bq, bk, t_pad = _block_sizes(T, block_q, block_k)
     qt, kt, vt = (_pad_bh(x, t_pad) for x in (q, k, v))
+    km = _pad_km(kmask, t_pad) if kmask is not None else None
     out, lse = _fwd_pallas_call(
         qt, kt, vt, D=D, bq=bq, bk=bk, q_pad=t_pad, k_pad=t_pad, t_real_k=T,
         causal=causal, scale=scale, q_off=0, k_off=0, interpret=interpret,
-        dtype=q.dtype)
+        dtype=q.dtype, kmask=km, H=H)
     res = _from_bh(out, B, T, H)
     return (res, lse) if with_lse else res
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, block_q: int, block_k: int, t_real_q: int,
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                   block_q: int, block_k: int, t_real_q: int,
                    t_real_k: int, k_pad: int, causal: bool, scale: float,
-                   q_off: int = 0, k_off: int = 0):
+                   q_off: int = 0, k_off: int = 0, has_kmask: bool = False):
     """dq for one q-block: dq = scale * sum_k [p * (do@v^T - delta)] @ k,
     p = exp(q@k^T*scale - lse) (FlashAttention-2 backward, eq. dS).
     ``delta`` may already carry the -dlse shift (differentiable-lse path:
     ds = p * (dp - delta + dlse)). Validity masks use LOCAL positions vs
     t_real_q/t_real_k; the causal comparison uses ABSOLUTE positions
-    (q_off/k_off — chunked/ring blocks)."""
+    (q_off/k_off — chunked/ring blocks). Optional kmask ref [1, 1, k_pad]
+    per batch ANDs into validity, mirroring the forward."""
+    if has_kmask:
+        km_ref, dq_ref = rest
+    else:
+        (dq_ref,), km_ref = rest, None
     qi = pl.program_id(1)
     q = q_ref[0]                                                 # [bq, D]
     do = do_ref[0]                                               # [bq, D]
@@ -217,6 +255,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         if causal:
             valid = jnp.logical_and(valid,
                                     k_off + k_loc <= q_off + q_loc)
+        if km_ref is not None:
+            km = km_ref[0, :, pl.ds(kb * block_k, block_k)]      # [1, bk]
+            valid = jnp.logical_and(valid, km > 0)
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)              # [bq, bk]
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = (p * (dp - delta)).astype(k.dtype)
@@ -232,18 +273,26 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, block_q: int, block_k: int,
+                    *rest, block_q: int, block_k: int,
                     t_real_q: int, t_real_k: int, q_pad: int, causal: bool,
-                    scale: float, q_off: int = 0, k_off: int = 0):
+                    scale: float, q_off: int = 0, k_off: int = 0,
+                    has_kmask: bool = False):
     """dk/dv for one k-block, looping over q-blocks:
     dv = sum_q p^T @ do;  dk = scale * sum_q [p*(do@v^T - delta)]^T @ q.
-    Same delta/offset semantics as _bwd_dq_kernel."""
+    Same delta/offset semantics as _bwd_dq_kernel. Optional kmask ref
+    [1, 1, block_k] (THIS k-block's validity slice, per batch)."""
+    if has_kmask:
+        km_ref, dk_ref, dv_ref = rest
+    else:
+        (dk_ref, dv_ref), km_ref = rest, None
     ki = pl.program_id(1)
     k = k_ref[0]                                                 # [bk, D]
     v = v_ref[0]
     k_loc = ki * block_k + lax.broadcasted_iota(
         jnp.int32, (1, block_k), 1)                              # [1, bk]
     k_valid = k_loc < t_real_k
+    if km_ref is not None:
+        k_valid = jnp.logical_and(k_valid, km_ref[0] > 0)        # [1, bk]
 
     def body(qb, carry):
         dk, dv = carry
@@ -281,9 +330,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_pallas_calls(qt, kt, vt, dot, lse, delta, *, D, bq, bk, q_pad,
                       k_pad, t_real_q, t_real_k, causal, scale, q_off,
-                      k_off, interpret, dtype):
+                      k_off, interpret, dtype, kmask=None, H=1):
     """The two backward pallas_calls over padded [BH, ., D] arrays; returns
-    padded (dq, dk, dv). ``delta`` may already carry the -dlse shift."""
+    padded (dq, dk, dv). ``delta`` may already carry the -dlse shift.
+    ``kmask``: optional [B, 1, k_pad] f32 rows (per batch; bh // H)."""
     BH = qt.shape[0]
     kw = {}
     if _VMEM is not None and not interpret:
@@ -291,41 +341,54 @@ def _bwd_pallas_calls(qt, kt, vt, dot, lse, delta, *, D, bq, bk, q_pad,
     full = lambda bh, i: (bh, 0, 0)          # noqa: E731
     blkq = lambda bh, i: (bh, i, 0)          # noqa: E731
     row = lambda bh, i: (bh, 0, i)           # noqa: E731
+    has_km = kmask is not None
 
+    dq_in_specs = [
+        pl.BlockSpec((1, bq, D), blkq, **kw),
+        pl.BlockSpec((1, k_pad, D), full, **kw),
+        pl.BlockSpec((1, k_pad, D), full, **kw),
+        pl.BlockSpec((1, bq, D), blkq, **kw),
+        pl.BlockSpec((1, 1, bq), row, **kw),
+        pl.BlockSpec((1, 1, bq), row, **kw),
+    ]
+    dq_args = [qt, kt, vt, dot, lse, delta]
+    if has_km:
+        dq_in_specs.append(
+            pl.BlockSpec((1, 1, k_pad), lambda bh, i: (bh // H, 0, 0), **kw))
+        dq_args.append(kmask)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_q=bq, block_k=bk,
                           t_real_q=t_real_q, t_real_k=t_real_k, k_pad=k_pad,
                           causal=causal, scale=scale, q_off=q_off,
-                          k_off=k_off),
+                          k_off=k_off, has_kmask=has_km),
         grid=(BH, q_pad // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, D), blkq, **kw),
-            pl.BlockSpec((1, k_pad, D), full, **kw),
-            pl.BlockSpec((1, k_pad, D), full, **kw),
-            pl.BlockSpec((1, bq, D), blkq, **kw),
-            pl.BlockSpec((1, 1, bq), row, **kw),
-            pl.BlockSpec((1, 1, bq), row, **kw),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, bq, D), blkq, **kw),
         out_shape=jax.ShapeDtypeStruct((BH, q_pad, D), dtype),
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
+    )(*dq_args)
 
     blkk = lambda bh, i: (bh, i, 0)          # noqa: E731
+    dkv_in_specs = [
+        pl.BlockSpec((1, q_pad, D), full, **kw),
+        pl.BlockSpec((1, bk, D), blkk, **kw),
+        pl.BlockSpec((1, bk, D), blkk, **kw),
+        pl.BlockSpec((1, q_pad, D), full, **kw),
+        pl.BlockSpec((1, 1, q_pad), full, **kw),
+        pl.BlockSpec((1, 1, q_pad), full, **kw),
+    ]
+    dkv_args = [qt, kt, vt, dot, lse, delta]
+    if has_km:
+        dkv_in_specs.append(
+            pl.BlockSpec((1, 1, bk), lambda bh, i: (bh // H, 0, i), **kw))
+        dkv_args.append(kmask)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=bq, block_k=bk,
                           t_real_q=t_real_q, t_real_k=t_real_k, q_pad=q_pad,
                           causal=causal, scale=scale, q_off=q_off,
-                          k_off=k_off),
+                          k_off=k_off, has_kmask=has_km),
         grid=(BH, k_pad // bk),
-        in_specs=[
-            pl.BlockSpec((1, q_pad, D), full, **kw),
-            pl.BlockSpec((1, bk, D), blkk, **kw),
-            pl.BlockSpec((1, bk, D), blkk, **kw),
-            pl.BlockSpec((1, q_pad, D), full, **kw),
-            pl.BlockSpec((1, 1, q_pad), full, **kw),
-            pl.BlockSpec((1, 1, q_pad), full, **kw),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, bk, D), blkk, **kw),
             pl.BlockSpec((1, bk, D), blkk, **kw),
@@ -335,7 +398,7 @@ def _bwd_pallas_calls(qt, kt, vt, dot, lse, delta, *, D, bq, bk, q_pad,
             jax.ShapeDtypeStruct((BH, k_pad, D), dtype),
         ],
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
+    )(*dkv_args)
     return dq, dk, dv
 
 
@@ -347,7 +410,7 @@ def _row_layout(x2d, B, H, T, t_pad):
     return r
 
 
-def _flash_bwd_pallas(q, k, v, o, lse, g, causal: bool, block_q: int,
+def _flash_bwd_pallas(q, k, v, kmask, o, lse, g, causal: bool, block_q: int,
                       block_k: int, interpret: bool):
     """Blockwise backward: scores are rebuilt in VMEM from q/k/v and the
     forward's row-layout logsumexp — no [T, T] tensor ever reaches HBM."""
@@ -356,6 +419,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal: bool, block_q: int,
     bq, bk, t_pad = _block_sizes(T, block_q, block_k)
 
     qt, kt, vt, dot = (_pad_bh(x, t_pad) for x in (q, k, v, g))
+    km = _pad_km(kmask, t_pad) if kmask is not None else None
     # delta_i = rowsum(do_i * o_i): cheap elementwise XLA, f32; same
     # [BH, 1, t_pad] row layout as lse
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
@@ -364,13 +428,15 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal: bool, block_q: int,
     dq, dk, dv = _bwd_pallas_calls(
         qt, kt, vt, dot, lse, delta, D=D, bq=bq, bk=bk, q_pad=t_pad,
         k_pad=t_pad, t_real_q=T, t_real_k=T, causal=causal, scale=scale,
-        q_off=0, k_off=0, interpret=interpret, dtype=q.dtype)
+        q_off=0, k_off=0, interpret=interpret, dtype=q.dtype, kmask=km, H=H)
     return (_from_bh(dq, B, T, H), _from_bh(dk, B, T, H),
             _from_bh(dv, B, T, H))
 
 
-def _reference(q, k, v, causal: bool):
-    """The same math in plain XLA ops — used by the equivalence tests."""
+def _reference(q, k, v, causal: bool, kmask=None):
+    """The same math in plain XLA ops — used by the equivalence tests.
+    Matches parallel/ring.py local_attention semantics incl. the
+    fully-masked-row clamp."""
     scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum("bqhd,bkhd->bhqk",
                    q.astype(jnp.float32), k.astype(jnp.float32)) * scale
@@ -378,11 +444,13 @@ def _reference(q, k, v, causal: bool):
         T = q.shape[1]
         msk = jnp.tril(jnp.ones((T, T), bool))
         s = jnp.where(msk[None, None], s, _NEG_BIG)
+    if kmask is not None:
+        s = jnp.where(kmask[:, None, None, :] > 0, s, _NEG_BIG)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _reference_chunked(q, k, v, causal: bool, chunk: int = 128):
+def _reference_chunked(q, k, v, causal: bool, chunk: int = 128, kmask=None):
     """Attention computed q-chunk-at-a-time with ``lax.map`` — identical
     math to :func:`_reference`, but only [B, H, chunk, T] scores exist at
     once. The custom VJP differentiates THIS function, so the backward is
@@ -405,6 +473,8 @@ def _reference_chunked(q, k, v, causal: bool, chunk: int = 128):
         if causal:
             valid = k_pos[None, :] <= q_pos[:, None]
         s = jnp.where(valid[None, None], s, _NEG_BIG)
+        if kmask is not None:
+            s = jnp.where(kmask[:, None, None, :] > 0, s, _NEG_BIG)
         p = jax.nn.softmax(s, axis=-1)
         return jnp.einsum("bhqk,bkhd->bqhd", p, vf)        # [B,chunk,H,D]
 
@@ -413,50 +483,62 @@ def _reference_chunked(q, k, v, causal: bool, chunk: int = 128):
     return out[:, :T].astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, block_q, block_k, interpret, bwd):
-    return _flash_raw(q, k, v, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, kmask, causal, block_q, block_k, interpret, bwd):
+    return _flash_raw(q, k, v, kmask, causal, block_q, block_k, interpret)
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, bwd):
+def _flash_fwd(q, k, v, kmask, causal, block_q, block_k, interpret, bwd):
     if bwd == "pallas":
-        out, lse = _flash_raw(q, k, v, causal, block_q, block_k, interpret,
-                              with_lse=True)
-        return out, (q, k, v, out, lse)
+        out, lse = _flash_raw(q, k, v, kmask, causal, block_q, block_k,
+                              interpret, with_lse=True)
+        return out, (q, k, v, kmask, out, lse)
     # the xla fallback exists for memory-constrained cases: don't burden it
     # with the out/lse residuals it never reads
-    out = _flash_raw(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v, None, None)
+    out = _flash_raw(q, k, v, kmask, causal, block_q, block_k, interpret)
+    return out, (q, k, v, kmask, None, None)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, bwd, res, g):
-    q, k, v, o, lse = res
+    q, k, v, kmask, o, lse = res
+    dkm = (jnp.zeros_like(kmask) if kmask is not None else None)
     if bwd == "pallas":
-        return _flash_bwd_pallas(q, k, v, o, lse, g, causal, block_q,
-                                 block_k, interpret)
+        dq, dk, dv = _flash_bwd_pallas(q, k, v, kmask, o, lse, g, causal,
+                                       block_q, block_k, interpret)
+        return dq, dk, dv, dkm
     # XLA rematerialisation fallback (also the correctness oracle in
     # tests). Chunking is a memory/throughput trade: lax.map serialises
     # chunks (~15% slower at T=2048), so use the dense [T,T] recompute
     # while the f32 score tensor is affordable and switch to q-chunks only
     # when it is not.
     B, T, H, _ = q.shape
+    if kmask is not None:
+        # agree with the Pallas backward on fully-masked query rows: the
+        # kernel's validity mask makes their p (hence dq and their dk/dv
+        # contributions) exactly zero, while _reference's softmax over an
+        # all-_NEG_BIG row is uniform — zero those rows' cotangent here
+        has_valid = (jnp.cumsum(kmask, axis=1) > 0) if causal else \
+            (jnp.sum(kmask, axis=1, keepdims=True) > 0)          # [B, T]/[B,1]
+        g = g * has_valid[:, :, None, None].astype(g.dtype)
     score_bytes = 4 * B * H * T * T
     # the dense vjp holds ~3 score-sized f32 tensors at once (softmax
     # residual p + dp/ds temporaries), so budget for 3x, not 1x
     if 3 * score_bytes <= 4 << 30:
-        fn = lambda q_, k_, v_: _reference(q_, k_, v_, causal)
+        fn = lambda q_, k_, v_: _reference(q_, k_, v_, causal, kmask)
     else:
-        fn = lambda q_, k_, v_: _reference_chunked(q_, k_, v_, causal)
+        fn = lambda q_, k_, v_: _reference_chunked(q_, k_, v_, causal,
+                                                   kmask=kmask)
     _, vjp = jax.vjp(fn, q, k, v)
-    return vjp(g)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, dkm
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, *, causal: bool = False, block_q: int = 128,
-                    block_k: int = 128, interpret: bool = False,
-                    bwd: str = "pallas"):
+def flash_attention(q, k, v, *, kmask=None, causal: bool = False,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False, bwd: str = "pallas"):
     """Blockwise flash attention over [B, T, H, D] (differentiable).
 
     Forward runs the Pallas kernel (never materialises [T, T]); the
@@ -464,13 +546,22 @@ def flash_attention(q, k, v, *, causal: bool = False, block_q: int = 128,
     dk/dv grid over k-blocks) consuming the forward's logsumexp residual —
     ``bwd="xla"`` selects the rematerialising XLA fallback (the tests'
     correctness oracle). ``interpret=True`` runs the kernels in the Pallas
-    interpreter (CPU tests)."""
+    interpreter (CPU tests). ``kmask`` [B, T]: key validity (1=real,
+    0=padding) shared across heads — the padded/variable-length batch case;
+    the kernel loads one [1, block_k] row slice per key block and ANDs it
+    into the validity mask, so masked training keeps the flash memory
+    envelope."""
     if bwd not in ("pallas", "xla"):
         raise ValueError(f"bwd must be 'pallas' or 'xla', got {bwd!r}")
-    return _flash(q, k, v, causal, block_q, block_k, interpret, bwd)
+    if kmask is not None:
+        # float at the custom_vjp boundary (integer args would need float0
+        # cotangents); the bwd returns zeros for it
+        kmask = jnp.asarray(kmask, jnp.float32)
+    return _flash(q, k, v, kmask, causal, block_q, block_k, interpret, bwd)
 
 
-def flash_attention_block(q, k, v, *, q_offset: int = 0, k_offset: int = 0,
+def flash_attention_block(q, k, v, *, kmask=None, q_offset: int = 0,
+                          k_offset: int = 0,
                           causal: bool = False, block_q: int = 128,
                           block_k: int = 128, interpret: bool = False):
     """FORWARD-ONLY building block for chunked/ring attention: attention of
@@ -479,23 +570,25 @@ def flash_attention_block(q, k, v, *, q_offset: int = 0, k_offset: int = 0,
     ``(out, lse [B, H, T])`` — the per-row logsumexp needed to merge
     partial results across chunks with :func:`merge_attention_blocks`.
 
-    Rows whose keys are entirely masked (causal, q < k_offset) return a
-    ~-1e30 lse whose merge weight underflows to exactly 0 — but their
-    ``out`` is mean(v), NOT 0 (every masked score equals the running-max
-    sentinel, so p=1 uniformly). ``out`` alone is therefore meaningless
-    without the lse weighting: always combine via merge_attention_blocks."""
+    Rows whose keys are entirely masked (causal, q < k_offset; or a fully
+    kmasked chunk) return a ~-1e30 lse whose merge weight underflows to
+    exactly 0 — but their ``out`` is mean(v), NOT 0 (every masked score
+    equals the running-max sentinel, so p=1 uniformly). ``out`` alone is
+    therefore meaningless without the lse weighting: always combine via
+    merge_attention_blocks. ``kmask`` [B, Tk]: THIS key chunk's validity."""
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale = 1.0 / (D ** 0.5)
     bq, bk, q_pad, k_pad = _block_sizes2(Tq, Tk, block_q, block_k)
     qt = _pad_bh(q, q_pad)
     kt, vt = _pad_bh(k, k_pad), _pad_bh(v, k_pad)
+    km = _pad_km(kmask, k_pad) if kmask is not None else None
     # t_real_k gates KEY validity (Tk, not Tq — the chunk may be shorter);
     # padded q rows emit garbage that is sliced off below
     out, lse = _fwd_pallas_call(
         qt, kt, vt, D=D, bq=bq, bk=bk, q_pad=q_pad, k_pad=k_pad, t_real_k=Tk,
         causal=causal, scale=scale, q_off=q_offset, k_off=k_offset,
-        interpret=interpret, dtype=q.dtype)
+        interpret=interpret, dtype=q.dtype, kmask=km, H=H)
     # fully masked rows: m stays _NEG_BIG so lse = m + log(l) is ~-1e30
     # and the merge weight underflows to 0 (their out is mean(v), see
     # docstring — only the weighted combination is meaningful)
@@ -503,20 +596,20 @@ def flash_attention_block(q, k, v, *, q_offset: int = 0, k_offset: int = 0,
     return _from_bh(out, B, Tq, H), lse_b
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash_block_diff(q, k, v, q_offset, k_offset, causal, block_q, block_k,
-                      interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_block_diff(q, k, v, kmask, q_offset, k_offset, causal, block_q,
+                      block_k, interpret):
     return flash_attention_block(
-        q, k, v, q_offset=q_offset, k_offset=k_offset, causal=causal,
-        block_q=block_q, block_k=block_k, interpret=interpret)
+        q, k, v, kmask=kmask, q_offset=q_offset, k_offset=k_offset,
+        causal=causal, block_q=block_q, block_k=block_k, interpret=interpret)
 
 
-def _flash_block_diff_fwd(q, k, v, q_offset, k_offset, causal, block_q,
-                          block_k, interpret):
+def _flash_block_diff_fwd(q, k, v, kmask, q_offset, k_offset, causal,
+                          block_q, block_k, interpret):
     out, lse = flash_attention_block(
-        q, k, v, q_offset=q_offset, k_offset=k_offset, causal=causal,
-        block_q=block_q, block_k=block_k, interpret=interpret)
-    return (out, lse), (q, k, v, out, lse)
+        q, k, v, kmask=kmask, q_offset=q_offset, k_offset=k_offset,
+        causal=causal, block_q=block_q, block_k=block_k, interpret=interpret)
+    return (out, lse), (q, k, v, kmask, out, lse)
 
 
 def _flash_block_diff_bwd(q_offset, k_offset, causal, block_q, block_k,
@@ -526,7 +619,7 @@ def _flash_block_diff_bwd(q_offset, k_offset, causal, block_q, block_k,
     ds = p * (do@v^T - delta + dlse)  =>  delta_eff = delta - dlse
     (FlashAttention-2 eq. dS extended for a differentiable logsumexp —
     exactly what chunk-merged/ring attention training needs)."""
-    q, k, v, o, lse = res
+    q, k, v, kmask, o, lse = res
     do, dlse = cts
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
@@ -534,6 +627,7 @@ def _flash_block_diff_bwd(q_offset, k_offset, causal, block_q, block_k,
     bq, bk, q_pad, k_pad = _block_sizes2(Tq, Tk, block_q, block_k)
     qt, dot = _pad_bh(q, q_pad), _pad_bh(do, q_pad)
     kt, vt = _pad_bh(k, k_pad), _pad_bh(v, k_pad)
+    km = _pad_km(kmask, k_pad) if kmask is not None else None
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta = jnp.swapaxes(delta, 1, 2) - dlse.astype(jnp.float32)  # [B,H,Tq]
     delta = _row_layout(delta, B, H, Tq, q_pad)
@@ -541,15 +635,17 @@ def _flash_block_diff_bwd(q_offset, k_offset, causal, block_q, block_k,
     dq, dk, dv = _bwd_pallas_calls(
         qt, kt, vt, dot, lse_r, delta, D=D, bq=bq, bk=bk, q_pad=q_pad,
         k_pad=k_pad, t_real_q=Tq, t_real_k=Tk, causal=causal, scale=scale,
-        q_off=q_offset, k_off=k_offset, interpret=interpret, dtype=q.dtype)
+        q_off=q_offset, k_off=k_offset, interpret=interpret, dtype=q.dtype,
+        kmask=km, H=H)
+    dkm = jnp.zeros_like(kmask) if kmask is not None else None
     return (_from_bh(dq, B, Tq, H), _from_bh(dk, B, Tk, H),
-            _from_bh(dv, B, Tk, H))
+            _from_bh(dv, B, Tk, H), dkm)
 
 
 _flash_block_diff.defvjp(_flash_block_diff_fwd, _flash_block_diff_bwd)
 
 
-def flash_attention_block_grad(q, k, v, *, q_offset: int = 0,
+def flash_attention_block_grad(q, k, v, *, kmask=None, q_offset: int = 0,
                                k_offset: int = 0, causal: bool = False,
                                block_q: int = 128, block_k: int = 128,
                                interpret: bool = False):
@@ -558,8 +654,10 @@ def flash_attention_block_grad(q, k, v, *, q_offset: int = 0,
     the merge (and anything downstream of it) backpropagates exactly
     through every chunk via blockwise Pallas kernels. This is the
     training-capable building block for chunk-sequential and ring
-    attention schedules."""
-    return _flash_block_diff(q, k, v, q_offset, k_offset, causal,
+    attention schedules. ``kmask`` [B, Tk]: this key chunk's validity."""
+    if kmask is not None:
+        kmask = jnp.asarray(kmask, jnp.float32)
+    return _flash_block_diff(q, k, v, kmask, q_offset, k_offset, causal,
                              block_q, block_k, interpret)
 
 

@@ -96,7 +96,7 @@ def _ring_attention_shard(q, k, v, kmask, *, axis_name: str, causal: bool):
     return (acc / jnp.transpose(l, (0, 2, 1))[..., None]).astype(out_dtype)
 
 
-def _ring_flash_shard(q, k, v, *, axis_name: str, causal: bool,
+def _ring_flash_shard(q, k, v, kmask=None, *, axis_name: str, causal: bool,
                       interpret: bool):
     """Flash-backed ring attention shard (round 4): each arriving k/v block
     is attended with the Pallas chunked kernel and the partials merge by
@@ -109,7 +109,9 @@ def _ring_flash_shard(q, k, v, *, axis_name: str, causal: bool,
     step 0 runs the local causal kernel; every later step is either fully
     allowed (source shard strictly before ours) or fully masked — a traced
     where() on the block's lse (weight -> 0) handles that, keeping block
-    offsets static."""
+    offsets static. ``kmask`` [B, T_local]: this shard's key validity; it
+    rotates around the ring with its k/v block and feeds the chunk kernel's
+    per-key-block mask (round 5)."""
     from deeplearning4j_tpu.ops.flash_attention import (
         flash_attention_block_grad, merge_attention_blocks)
 
@@ -117,10 +119,11 @@ def _ring_flash_shard(q, k, v, *, axis_name: str, causal: bool,
     my_idx = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
     parts = []
-    kc, vc = k, v
+    kc, vc, kmc = k, v, kmask
     for i in range(axis_size):          # static unroll, like the XLA ring
         o_i, lse_i = flash_attention_block_grad(
-            q, kc, vc, causal=(causal and i == 0), interpret=interpret)
+            q, kc, vc, kmask=kmc, causal=(causal and i == 0),
+            interpret=interpret)
         if causal and i > 0:
             src = (my_idx - i) % axis_size       # which shard's block this is
             allowed = src < my_idx               # strictly-past blocks only
@@ -129,6 +132,8 @@ def _ring_flash_shard(q, k, v, *, axis_name: str, causal: bool,
         if i + 1 < axis_size:
             kc = lax.ppermute(kc, axis_name, perm)
             vc = lax.ppermute(vc, axis_name, perm)
+            if kmc is not None:
+                kmc = lax.ppermute(kmc, axis_name, perm)
     return merge_attention_blocks(parts)
 
 
@@ -166,24 +171,27 @@ def ring_self_attention(
     blocks over ``seq_axis``. Pass ``head_axis="model"`` when q/k/v are
     head-sharded by tensor parallelism (column-parallel Wqkv) so the kernel
     runs on local heads instead of forcing an all-gather over the model axis.
-    ``use_flash=True`` (kmask-free only) runs each ring block through the
-    Pallas chunked kernel with exact streaming-softmax merging — no
-    per-block score tensor, fully differentiable. Inputs/outputs
-    [B, T, H, D] global arrays; kmask [B, T] or None."""
+    ``use_flash=True`` runs each ring block through the Pallas chunked
+    kernel with exact streaming-softmax merging — no per-block score
+    tensor, fully differentiable; a kmask rides the ring alongside its
+    k/v block. Inputs/outputs [B, T, H, D] global arrays; kmask [B, T]
+    or None."""
     spec = P(data_axis, seq_axis, head_axis, None)
     mspec = P(data_axis, seq_axis)
-    if kmask is None and use_flash:
+    if use_flash:
         fn_flash = functools.partial(
             _ring_flash_shard, axis_name=seq_axis, causal=causal,
             interpret=jax.default_backend() != "tpu")
+        in_specs = (spec, spec, spec) if kmask is None else (spec, spec, spec, mspec)
+        args = (q, k, v) if kmask is None else (q, k, v, kmask)
         try:
             # pallas_call outputs carry no vma annotation; disable the
             # shard_map varying-axes check for this (correct) spec
-            return shard_map(fn_flash, mesh=mesh, in_specs=(spec, spec, spec),
-                             out_specs=spec, check_vma=False)(q, k, v)
+            return shard_map(fn_flash, mesh=mesh, in_specs=in_specs,
+                             out_specs=spec, check_vma=False)(*args)
         except TypeError:  # older jax: parameter named check_rep / absent
-            return shard_map(fn_flash, mesh=mesh, in_specs=(spec, spec, spec),
-                             out_specs=spec)(q, k, v)
+            return shard_map(fn_flash, mesh=mesh, in_specs=in_specs,
+                             out_specs=spec)(*args)
     fn = functools.partial(_ring_attention_shard, axis_name=seq_axis, causal=causal)
     if kmask is None:
         def fn_nomask(q, k, v):

@@ -161,15 +161,16 @@ class TestLayerPolicy:
             self._layer_out("auto", x), self._layer_out(False, x),
             rtol=0, atol=0)
 
-    def test_masked_attention_falls_back(self):
-        # a key mask routes to the XLA path even when flash is forced
+    def test_masked_attention_uses_flash(self):
+        # round 5: a key mask runs IN the kernel (forced flash) and matches
+        # the masked XLA path to float tolerance
         rs = np.random.RandomState(5)
         x = jnp.asarray(rs.randn(2, 12, 16).astype(np.float32))
         mask = jnp.asarray(np.concatenate(
             [np.ones((2, 9)), np.zeros((2, 3))], 1).astype(np.float32))
         np.testing.assert_allclose(
             self._layer_out(True, x, mask), self._layer_out(False, x, mask),
-            rtol=0, atol=0)
+            rtol=1e-5, atol=2e-5)
 
     def test_serde_round_trip_with_flag(self):
         from deeplearning4j_tpu.nn.config import LayerConfig
@@ -365,3 +366,129 @@ class TestDifferentiableBlocks:
             assert np.all(np.isfinite(np.asarray(a)))
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=5e-4)
+
+
+class TestKmask:
+    """Round-5: key-validity masks inside the kernel (VERDICT r4 #4) —
+    forward and both Pallas backwards match the masked XLA oracle."""
+
+    @staticmethod
+    def _mask(rs, B, T):
+        # variable-length padding: every row keeps >=1 valid key
+        lens = rs.randint(1, T + 1, B)
+        m = (np.arange(T)[None, :] < lens[:, None]).astype(np.float32)
+        return jnp.asarray(m)
+
+    @pytest.mark.parametrize("shape", [(2, 16, 2, 8), (2, 50, 3, 32)])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_masked_reference(self, shape, causal):
+        rs = np.random.RandomState(7)
+        q, k, v = _qkv(rs, *shape)
+        km = self._mask(rs, shape[0], shape[1])
+        out = flash_attention(q, k, v, kmask=km, causal=causal,
+                              block_q=16, block_k=16, interpret=True)
+        ref = _reference(q, k, v, causal, kmask=km)
+        # compare only valid QUERY rows (padded-position queries are
+        # meaningless and masked downstream by the layer stack)
+        w = np.asarray(km)[:, :, None, None]
+        np.testing.assert_allclose(np.asarray(out) * w, np.asarray(ref) * w,
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_pallas_backward_matches_masked_reference(self, causal):
+        rs = np.random.RandomState(8)
+        B, T, H, D = 2, 40, 2, 16
+        q, k, v = _qkv(rs, B, T, H, D)
+        km = self._mask(rs, B, T)
+        w = jnp.asarray(np.asarray(km)[:, :, None, None])
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, kmask=km, causal=causal,
+                                block_q=16, block_k=16, interpret=True,
+                                bwd="pallas")
+            return jnp.sum((o * w) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum((_reference(q, k, v, causal, kmask=km) * w) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_xla_bwd_flag_with_kmask(self):
+        rs = np.random.RandomState(9)
+        B, T, H, D = 1, 24, 2, 8
+        q, k, v = _qkv(rs, B, T, H, D)
+        km = self._mask(rs, B, T)
+        w = jnp.asarray(np.asarray(km)[:, :, None, None])
+        gp = jax.grad(lambda q: jnp.sum((flash_attention(
+            q, k, v, kmask=km, causal=True, block_q=8, block_k=8,
+            interpret=True, bwd="pallas") * w) ** 2))(q)
+        gx = jax.grad(lambda q: jnp.sum((flash_attention(
+            q, k, v, kmask=km, causal=True, block_q=8, block_k=8,
+            interpret=True, bwd="xla") * w) ** 2))(q)
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gx),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_masked_keys_get_zero_kv_grads(self):
+        """dk/dv at masked key positions must be exactly zero."""
+        rs = np.random.RandomState(10)
+        B, T, H, D = 2, 16, 2, 8
+        q, k, v = _qkv(rs, B, T, H, D)
+        km = jnp.asarray(np.concatenate(
+            [np.ones((B, 10)), np.zeros((B, 6))], 1).astype(np.float32))
+        gk, gv = jax.grad(lambda k, v: jnp.sum(flash_attention(
+            q, k, v, kmask=km, block_q=8, block_k=8, interpret=True) ** 2),
+            argnums=(0, 1))(k, v)
+        np.testing.assert_allclose(np.asarray(gk)[:, 10:], 0.0, atol=0)
+        np.testing.assert_allclose(np.asarray(gv)[:, 10:], 0.0, atol=0)
+
+    def test_chunked_block_kmask_merge_equals_full(self):
+        """Two key chunks with per-chunk kmask slices merge to the full
+        masked attention (the ring path's building block)."""
+        from deeplearning4j_tpu.ops.flash_attention import (
+            flash_attention_block_grad, merge_attention_blocks)
+
+        rs = np.random.RandomState(11)
+        B, T, H, D = 2, 32, 2, 8
+        q, k, v = _qkv(rs, B, T, H, D)
+        km = self._mask(rs, B, T)
+        half = T // 2
+        parts = [
+            flash_attention_block_grad(
+                q, k[:, :half], v[:, :half], kmask=km[:, :half],
+                q_offset=0, k_offset=0, block_q=8, block_k=8, interpret=True),
+            flash_attention_block_grad(
+                q, k[:, half:], v[:, half:], kmask=km[:, half:],
+                q_offset=0, k_offset=half, block_q=8, block_k=8,
+                interpret=True),
+        ]
+        out = merge_attention_blocks(parts)
+        ref = _reference(q, k, v, False, kmask=km)
+        w = np.asarray(km)[:, :, None, None]
+        np.testing.assert_allclose(np.asarray(out) * w, np.asarray(ref) * w,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_left_padded_bwd_flags_agree(self):
+        """Left-padded kmask + causal: rows with zero valid keys must get
+        identical (zero) gradients from bwd='pallas' and bwd='xla'."""
+        rs = np.random.RandomState(12)
+        B, T, H, D = 2, 16, 2, 8
+        q, k, v = _qkv(rs, B, T, H, D)
+        km = jnp.asarray(np.concatenate(
+            [np.zeros((B, 5)), np.ones((B, 11))], 1).astype(np.float32))
+
+        def grads(bwd):
+            return jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+                q, k, v, kmask=km, causal=True, block_q=8, block_k=8,
+                interpret=True, bwd=bwd) ** 2), argnums=(0, 1, 2))(q, k, v)
+
+        gp, gx = grads("pallas"), grads("xla")
+        for a, b in zip(gp, gx):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+        # fully-masked query rows (0..4): dq exactly zero in both
+        np.testing.assert_allclose(np.asarray(gp[0])[:, :5], 0.0, atol=0)
+        np.testing.assert_allclose(np.asarray(gx[0])[:, :5], 0.0, atol=0)
