@@ -136,6 +136,11 @@ class LayerConfig:
     # parameter constraints applied post-update inside the jitted step
     # (nn/conf/constraint/ parity; see nn/constraints.py for spec format)
     constraints: Any = ()
+    # per-layer gradient normalization (BaseLayer.gradientNormalization /
+    # gradientNormalizationThreshold parity — see
+    # train/updaters.apply_gradient_normalization for the mode names)
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: float = 1.0
     # train-time weight noise (nn/conf/weightnoise/ parity):
     #   {"type": "dropconnect", "p": 0.95}  p = weight RETAIN probability,
     #       inverted scaling (DropConnect.java applies DropOutInverted)

@@ -92,6 +92,15 @@ class BaseRecurrent(FeedForwardLayerConfig):
             stream = x
             cell = lambda c, v: self._cell(params, v, c)
 
+        # tie the carry's device-varying axes to x's: inside shard_map
+        # (GPipe stages, ring shards) a constant-zeros carry is unvarying
+        # while the scan body's outputs vary over the mesh axes — lax.scan
+        # rejects the carry type change. The zero-valued add is free after
+        # XLA folding but carries the vma annotation.
+        vtie = jnp.sum(x[..., :1]) * 0
+        carry = jax.tree_util.tree_map(
+            lambda c: c + vtie.astype(c.dtype), carry)
+
         def step(c, inp):
             v_t, m_t = inp if mask is not None else (inp, None)
             new_c = cell(c, v_t)

@@ -401,3 +401,166 @@ class TestVGG16BNPipeline:
         losses = [float(tr.fit_batch(x, y)) for _ in range(5)]
         assert np.isfinite(l0) and all(np.isfinite(l) for l in losses)
         assert losses[-1] < l0
+
+
+class TestTransformerPipeline:
+    """Round-5 (VERDICT r4 #5): the TransformerLM flagship pipelines —
+    embedding token-id stage input, transformer blocks mid-pipe, and the
+    vocab head optionally tensor-parallel (PP x TP composition)."""
+
+    @staticmethod
+    def _conf(updater=None):
+        from deeplearning4j_tpu.models import TransformerLM
+
+        return TransformerLM(
+            vocab_size=32, max_len=8, d_model=16, n_heads=2, n_blocks=2,
+            dtype="float32", seed=11,
+            updater=updater or {"type": "adam", "lr": 1e-3})
+
+    @staticmethod
+    def _lm_data(B=8, T=8, V=32, seed=3):
+        rs = np.random.RandomState(seed)
+        x = rs.randint(0, V, (B, T)).astype(np.int32)
+        y = np.eye(V, dtype=np.float32)[rs.randint(0, V, (B, T))]
+        return x, y
+
+    @staticmethod
+    def _assert_tree_match(piped, single, context=""):
+        # transformer layers hold NESTED param dicts — compare leaves
+        la = jax.tree_util.tree_leaves_with_path(piped.params)
+        lb = jax.tree_util.tree_leaves_with_path(single.params)
+        assert len(la) == len(lb)
+        for (pa, a), (_pb, b) in zip(la, lb):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=2e-4, atol=2e-5,
+                err_msg=f"param {jax.tree_util.keystr(pa)} diverged {context}")
+
+    def test_transformer_matches_single_device(self):
+        # sgd: adam would amplify float noise on the near-zero k-bias
+        # grads (softmax shift invariance) into sign-flip lr-sized drift
+        upd = {"type": "sgd", "lr": 0.05}
+        x, y = self._lm_data()
+        single = MultiLayerNetwork(self._conf(upd)).init()
+        single.fit((x, y), epochs=3)
+
+        mesh = make_mesh(MeshSpec(data=2, pipe=2, model=1, seq=2))
+        tr = GPipeTrainer(self._conf(upd), mesh, n_micro=2)
+        tr.fit((x, y), epochs=3)
+        self._assert_tree_match(tr.to_model(), single, "(transformer pp)")
+
+    def test_pp_tp_composition(self):
+        """PP x TP: vocab head column-sharded over 'model' while the body
+        pipelines over 'pipe' — must equal the single-device run too."""
+        upd = {"type": "sgd", "lr": 0.05}
+        x, y = self._lm_data(seed=4)
+        single = MultiLayerNetwork(self._conf(upd)).init()
+        single.fit((x, y), epochs=2)
+
+        mesh = make_mesh(MeshSpec(data=2, model=2, pipe=2, seq=1))
+        tr = GPipeTrainer(self._conf(upd), mesh, n_micro=2, tp_axis="model")
+        tr.fit((x, y), epochs=2)
+        self._assert_tree_match(tr.to_model(), single, "(pp x tp)")
+
+    def test_token_ids_above_bf16_range_survive(self):
+        """bf16 model: ids > 256 must reach the embedding intact (the
+        stage-0 id input skips the model-dtype cast)."""
+        from deeplearning4j_tpu.models import TransformerLM
+
+        conf = TransformerLM(vocab_size=2048, max_len=4, d_model=16,
+                             n_heads=2, n_blocks=1, dtype="bfloat16",
+                             seed=5, updater={"type": "sgd", "lr": 0.0})
+        rs = np.random.RandomState(6)
+        # ids chosen where bf16 rounding would corrupt (odd ids > 1024)
+        x = np.array([[1031, 2047, 513, 1025]] * 4, np.int32)
+        y = np.eye(2048, dtype=np.float32)[rs.randint(0, 2048, (4, 4))]
+
+        mesh = make_mesh(MeshSpec(data=2, pipe=2, model=1, seq=2))
+        tr = GPipeTrainer(conf, mesh, n_micro=2)
+        tr.fit_batch(x, y)
+        single = MultiLayerNetwork(conf).init()
+        out_s = np.asarray(single.output(x), np.float32)
+        out_p = np.asarray(tr.to_model().output(x), np.float32)
+        np.testing.assert_allclose(out_p, out_s, rtol=2e-2, atol=2e-2)
+
+
+class TestMasksGradNormConstraints:
+    """Round-5 (VERDICT r4 #8): masks, gradient normalization and
+    constraints in the pipelined step — all asserted EQUIVALENT to the
+    single-device run."""
+
+    @staticmethod
+    def _rnn_conf(gn=None, constraints=None):
+        from deeplearning4j_tpu.nn.layers.recurrent import LSTM, RnnOutputLayer
+
+        kw = {}
+        if gn:
+            kw["gradient_normalization"] = gn
+            kw["gradient_normalization_threshold"] = 1.0
+        if constraints:
+            kw["constraints"] = constraints
+        return MultiLayerConfiguration(
+            layers=(LSTM(n_out=8, **kw),
+                    Dense(n_out=6, activation="tanh", **kw),
+                    RnnOutputLayer(n_out=3, activation="softmax")),
+            input_type=InputType.recurrent(4, 10),
+            updater={"type": "sgd", "lr": 0.05}, seed=13)
+
+    @staticmethod
+    def _seq_data(B=8, T=10, seed=2):
+        rs = np.random.RandomState(seed)
+        x = rs.randn(B, T, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, (B, T))]
+        lens = rs.randint(3, T + 1, B)
+        fm = (np.arange(T)[None, :] < lens[:, None]).astype(np.float32)
+        return x, y, fm
+
+    def test_masked_training_matches_single_device(self):
+        x, y, fm = self._seq_data()
+        single = MultiLayerNetwork(self._rnn_conf()).init()
+        single.fit((x, y, fm), epochs=3)
+
+        mesh = make_mesh(MeshSpec(data=2, pipe=2, model=1, seq=2))
+        tr = GPipeTrainer(self._rnn_conf(), mesh, n_micro=2)
+        tr.fit((x, y, fm), epochs=3)
+        _assert_params_match(tr.to_model(), single, "(masked pp)")
+
+    def test_label_mask_matches_single_device(self):
+        x, y, fm = self._seq_data(seed=5)
+        single = MultiLayerNetwork(self._rnn_conf()).init()
+        single.fit((x, y, None, fm), epochs=2)
+
+        mesh = make_mesh(MeshSpec(data=2, pipe=2, model=1, seq=2))
+        tr = GPipeTrainer(self._rnn_conf(), mesh, n_micro=2)
+        tr.fit((x, y, None, fm), epochs=2)
+        _assert_params_match(tr.to_model(), single, "(lmask pp)")
+
+    def test_gradient_normalization_matches(self):
+        x, y, _ = self._seq_data(seed=3)
+        conf = lambda: self._rnn_conf(gn="clip_l2_per_layer")
+        single = MultiLayerNetwork(conf()).init()
+        single.fit((x, y), epochs=3)
+
+        mesh = make_mesh(MeshSpec(data=2, pipe=2, model=1, seq=2))
+        tr = GPipeTrainer(conf(), mesh, n_micro=2)
+        tr.fit((x, y), epochs=3)
+        _assert_params_match(tr.to_model(), single, "(grad-norm pp)")
+
+    def test_constraints_match(self):
+        x, y, _ = self._seq_data(seed=4)
+        conf = lambda: self._rnn_conf(constraints=[{"type": "max_norm", "max_norm": 0.5}])
+        single = MultiLayerNetwork(conf()).init()
+        single.fit((x, y), epochs=3)
+
+        mesh = make_mesh(MeshSpec(data=2, pipe=2, model=1, seq=2))
+        tr = GPipeTrainer(conf(), mesh, n_micro=2)
+        tr.fit((x, y), epochs=3)
+        _assert_params_match(tr.to_model(), single, "(constraints pp)")
+
+    def test_non_recurrent_mask_rejected(self):
+        mesh = make_mesh(MeshSpec(data=2, pipe=2, model=1, seq=2))
+        tr = GPipeTrainer(_mlp_conf({"type": "sgd", "lr": 0.05}), mesh,
+                          n_micro=2)
+        x, y = _data(n=8)
+        with pytest.raises(NotImplementedError, match="mask"):
+            tr.fit_batch(x, y, fm=np.ones((8, 1), np.float32))
