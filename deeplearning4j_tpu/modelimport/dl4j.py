@@ -97,12 +97,41 @@ def write_databuffer(s: io.BufferedIOBase, arr: np.ndarray, dtype: str):
 
 def read_nd4j(s: io.BufferedIOBase) -> np.ndarray:
     """Nd4j.read: shapeInfo int buffer [rank, shape.., stride.., offset,
-    elementWiseStride, order-char] followed by the data buffer."""
+    elementWiseStride, order-char] followed by the data buffer.
+
+    Obligations per docs/DL4J_DIALECT.md: the STRIDES are the layout ground
+    truth (the order char is only the fallback for ambiguous shapes),
+    nonzero offsets are rejected loudly, and the shapeInfo length must be
+    2*rank + 4."""
     shape_info = read_databuffer(s)
     rank = int(shape_info[0])
+    if len(shape_info) != 2 * rank + 4:
+        raise ValueError(
+            f"shapeInfo length {len(shape_info)} != 2*rank+4 (rank {rank})")
     shape = tuple(int(d) for d in shape_info[1:1 + rank])
+    strides = tuple(int(d) for d in shape_info[1 + rank:1 + 2 * rank])
+    offset = int(shape_info[1 + 2 * rank])
+    if offset != 0:
+        raise ValueError(f"nonzero ND4J array offset {offset} unsupported")
     order = chr(int(shape_info[2 * rank + 3]))
+
+    def contiguous(o):
+        acc, out = 1, [0] * rank
+        for i in (range(rank - 1, -1, -1) if o == "c" else range(rank)):
+            out[i] = acc
+            acc *= shape[i]
+        return tuple(out)
+
+    if strides == contiguous("c"):
+        order = "c"          # strides win over a disagreeing order char
+    elif strides == contiguous("f"):
+        order = "f"
+    else:
+        raise ValueError(
+            f"non-contiguous ND4J strides {strides} for shape {shape}")
     data = read_nd4j_databuffer_data(s)
+    if data.size != int(np.prod(shape)):
+        raise ValueError(f"data length {data.size} != prod{shape}")
     return np.reshape(data, shape, order=order)
 
 

