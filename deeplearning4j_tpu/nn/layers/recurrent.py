@@ -21,6 +21,7 @@ Streaming/tBPTT: every recurrent layer exposes
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional, Tuple
 
@@ -37,6 +38,26 @@ def _mask_step(mask_t, new, old):
     """Where mask_t==0, keep `old`; else `new`. mask_t: [batch]."""
     m = mask_t[:, None]
     return jnp.where(m > 0, new, old)
+
+
+_FUSED_SUPPRESS_DEPTH = 0
+
+
+def _fused_suppressed() -> bool:
+    return _FUSED_SUPPRESS_DEPTH > 0
+
+
+@contextmanager
+def no_fused_lstm():
+    """Trace-time guard: contexts whose SPMD machinery cannot host a
+    pallas_call (GPipe's vma-checked rank switch) wrap their step tracing
+    in this to force the lax.scan path regardless of policy."""
+    global _FUSED_SUPPRESS_DEPTH
+    _FUSED_SUPPRESS_DEPTH += 1
+    try:
+        yield
+    finally:
+        _FUSED_SUPPRESS_DEPTH -= 1
 
 
 @dataclass
@@ -172,6 +193,32 @@ class LSTM(BaseRecurrent):
 
     def _input_proj(self, params, x):
         return x @ params["Wx"] + params["b"]
+
+    def _fused_eligible(self) -> bool:
+        """The weight-stationary Pallas scan (ops/fused_lstm.py — the
+        CudnnLSTMHelper analog) covers the standard cell only: default
+        activations and a lane-aligned hidden width."""
+        return (self.activation == "tanh"
+                and self.gate_activation == "sigmoid"
+                and self.n_out % 128 == 0
+                and type(self) is LSTM)
+
+    def apply_seq(self, params, x, carry, mask=None):
+        import os as _os
+
+        policy = _os.environ.get("DL4J_TPU_FUSED_LSTM", "auto")
+        on_tpu = jax.default_backend() == "tpu"
+        use_fused = (policy == "1" or (policy == "auto" and on_tpu)) \
+            and self._fused_eligible() and not _fused_suppressed()
+        if not use_fused:
+            return super().apply_seq(params, x, carry, mask)
+        from deeplearning4j_tpu.ops.fused_lstm import fused_lstm
+
+        zx = self._input_proj(params, x)
+        h0, c0 = carry
+        out, (hT, cT) = fused_lstm(zx, params["Wh"], h0, c0, mask,
+                                   interpret=not on_tpu)
+        return out, (hT, cT)
 
     def _cell_from_proj(self, params, zx_t, carry):
         from deeplearning4j_tpu.nn import activations as A

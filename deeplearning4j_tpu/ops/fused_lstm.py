@@ -1,0 +1,357 @@
+"""Weight-stationary fused LSTM scan — the CudnnLSTMHelper analog.
+
+The reference accelerates its LSTMs with a fused cuDNN time loop
+(deeplearning4j-cuda/.../CudnnLSTMHelper.java, 612 LoC; shared math in
+LSTMHelpers.java:69,393). The TPU-native equivalent here is a Pallas
+kernel that runs the WHOLE recurrence in one kernel invocation:
+
+- The input projection x @ Wx + b is hoisted OUTSIDE (one [B*T, F] MXU
+  matmul, exactly like the XLA path in nn/layers/recurrent.py).
+- The kernel grids over time CHUNKS. TPU grids execute sequentially on a
+  core, so VMEM scratch persists across grid steps: the recurrent weights
+  Wh [H, 4H] stay resident in VMEM for the entire sequence (index_map
+  pins their block), and the h/c carries live in f32 scratch — nothing
+  recurrent touches HBM between timesteps. At the bench config
+  (H=256 bf16) Wh is 0.5 MB — re-fetched from HBM every scan iteration
+  by the XLA path, fetched ONCE here.
+- Per chunk it writes the h outputs plus the (bf16) gate/cell residuals
+  the backward needs.
+- The backward is a second Pallas kernel over the REVERSED chunk grid:
+  dh/dc ride in scratch, dWh accumulates in f32 scratch and is emitted on
+  the final grid step, dzx streams out per chunk (the cotangent of the
+  hoisted input projection — XLA autodiff handles Wx/b from there).
+
+Masking follows the framework's recurrent contract exactly (masked steps
+carry state through unchanged and output zeros — nn/layers/recurrent.py
+``apply_seq``): the forward blends carries with the mask, the backward
+routes carry-through cotangents around the gate path. Sequence padding
+(T not a multiple of the chunk) is the same mechanism with mask rows 0.
+
+Gate order is [i, f, g, o] (the framework's LSTM layout; DL4J's
+[g, f, o, i] order is permuted at import time by modelimport/dl4j.py).
+``interpret=True`` runs both kernels in the Pallas interpreter — the CPU
+test path (tests/test_fused_lstm.py asserts equivalence against the
+lax.scan oracle, forward and gradients, masked and unmasked).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+
+def _sig(x):
+    return jax.nn.sigmoid(x)
+
+
+def _fwd_kernel(zx_ref, wh_ref, h0_ref, c0_ref, m_ref,
+                hs_ref, gates_ref, cs_ref, hT_ref, cT_ref,
+                h_scr, c_scr, *, tc: int, H: int, n_chunks: int):
+    """One time-chunk: zx [B, tc, 4H]; Wh [H, 4H] (resident); h0/c0 [B, H];
+    m [B, tc]; outputs hs/cs [B, tc, H] (post-mask carries), gates
+    [B, tc, 4H] (pre-mask, bf16), final carries [B, H]. h/c persist in f32
+    scratch across the sequential chunk grid."""
+    ci = pl.program_id(0)
+
+    @pl.when(ci == 0)
+    def _init():
+        h_scr[...] = h0_ref[...].astype(jnp.float32)
+        c_scr[...] = c0_ref[...].astype(jnp.float32)
+
+    def step(t, _):
+        h = h_scr[...]
+        c = c_scr[...]
+        zx_t = zx_ref[:, t, :].astype(jnp.float32)            # [B, 4H]
+        z = zx_t + jnp.dot(h.astype(wh_ref.dtype), wh_ref[...],
+                           preferred_element_type=jnp.float32)
+        i = _sig(z[:, 0 * H:1 * H])
+        f = _sig(z[:, 1 * H:2 * H])
+        g = jnp.tanh(z[:, 2 * H:3 * H])
+        o = _sig(z[:, 3 * H:4 * H])
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        m = m_ref[:, t][:, None].astype(jnp.float32)          # [B, 1]
+        h_out = m * h_new + (1.0 - m) * h
+        c_out = m * c_new + (1.0 - m) * c
+        h_scr[...] = h_out
+        c_scr[...] = c_out
+        hs_ref[:, t, :] = h_out.astype(hs_ref.dtype)
+        cs_ref[:, t, :] = c_out.astype(cs_ref.dtype)
+        gates_ref[:, t, :] = jnp.concatenate(
+            [i, f, g, o], axis=-1).astype(gates_ref.dtype)
+        return 0
+
+    lax.fori_loop(0, tc, step, 0, unroll=True)
+
+    @pl.when(ci == n_chunks - 1)
+    def _final():
+        hT_ref[...] = h_scr[...].astype(hT_ref.dtype)
+        cT_ref[...] = c_scr[...].astype(cT_ref.dtype)
+
+
+def _bwd_kernel(gates_ref, cs_ref, cprev_ref, hprev_ref, wh_ref, m_ref,
+                dhs_ref, dcT_ref, dzx_ref, dwh_ref, dh0_ref, dc0_ref,
+                dh_scr, dc_scr, dwh_scr, *, tc: int, H: int, n_chunks: int):
+    """Reverse-grid chunk: consumes the forward residuals and the output
+    cotangent dhs; emits dzx per chunk and (on the last grid step = time
+    chunk 0) dWh / dh0 / dc0. dh/dc/dWh persist in f32 scratch; the
+    final-carry cotangents seed them (dhT is folded into dhs[T-1] by the
+    caller — h_T IS hs[:, T-1] — and dcT seeds the dc scratch here)."""
+    ci = pl.program_id(0)
+
+    @pl.when(ci == 0)
+    def _init():
+        dh_scr[...] = jnp.zeros_like(dh_scr)
+        dc_scr[...] = dcT_ref[...].astype(jnp.float32)
+        dwh_scr[...] = jnp.zeros_like(dwh_scr)
+
+    def step(k, _):
+        t = tc - 1 - k
+        gates = gates_ref[:, t, :].astype(jnp.float32)
+        i = gates[:, 0 * H:1 * H]
+        f = gates[:, 1 * H:2 * H]
+        g = gates[:, 2 * H:3 * H]
+        o = gates[:, 3 * H:4 * H]
+        c_t = cs_ref[:, t, :].astype(jnp.float32)
+        c_prev = cprev_ref[:, t, :].astype(jnp.float32)
+        m = m_ref[:, t][:, None].astype(jnp.float32)
+
+        # total cotangents on (h_t, c_t): carry + this step's output
+        # (the layer's emitted output is hs * m, so its cotangent arrives
+        # here already multiplied by m by the caller)
+        A = dh_scr[...] + dhs_ref[:, t, :].astype(jnp.float32)
+        C = dc_scr[...]
+
+        tanh_c = jnp.tanh(c_t)
+        dh_g = A * m                       # gate-path share
+        do = dh_g * tanh_c * o * (1.0 - o)
+        dcg = C * m + dh_g * o * (1.0 - tanh_c * tanh_c)
+        di = dcg * g * i * (1.0 - i)
+        dg = dcg * i * (1.0 - g * g)
+        df = dcg * c_prev * f * (1.0 - f)
+        dz = jnp.concatenate([di, df, dg, do], axis=-1)       # [B, 4H]
+
+        dzx_ref[:, t, :] = dz.astype(dzx_ref.dtype)
+        h_prev = hprev_ref[:, t, :].astype(jnp.float32)
+        dwh_scr[...] += jnp.dot(h_prev.astype(wh_ref.dtype).T,
+                                dz.astype(wh_ref.dtype),
+                                preferred_element_type=jnp.float32)
+        dh_scr[...] = jnp.dot(dz.astype(wh_ref.dtype),
+                              wh_ref[...].T,
+                              preferred_element_type=jnp.float32) \
+            + A * (1.0 - m)
+        dc_scr[...] = dcg * f + C * (1.0 - m)
+        return 0
+
+    lax.fori_loop(0, tc, step, 0, unroll=True)
+
+    @pl.when(ci == n_chunks - 1)
+    def _final():
+        dwh_ref[...] = dwh_scr[...].astype(dwh_ref.dtype)
+        dh0_ref[...] = dh_scr[...].astype(dh0_ref.dtype)
+        dc0_ref[...] = dc_scr[...].astype(dc0_ref.dtype)
+
+
+def _pick_chunk(T: int, B: int, H: int, itemsize: int) -> int:
+    """Time-chunk size: bounded by the VMEM block budget AND an absolute
+    ceiling (the kernels fully unroll the chunk — unbounded tc would blow
+    up compile time). Prefers divisors of T (no padding); falls back to
+    the padded path when T's divisors are all degenerate (prime T)."""
+    # per-timestep block bytes: zx 4H + gates 4H + hs H + cs H (+ cprev,
+    # hprev, dzx in the backward: budget 16H per step to be safe)
+    per_t = B * 16 * H * itemsize
+    cap = max(1, min(32, int((6 * 2 ** 20) // max(per_t, 1))))
+    best = 1
+    for tc in range(1, min(T, cap) + 1):
+        if T % tc == 0:
+            best = tc
+    if best >= max(cap // 2, 1) or best == T:
+        return best
+    return cap  # non-divisor: callers pad T with mask-0 rows
+
+
+def _pad_time(x, T_pad):
+    if x.shape[1] == T_pad:
+        return x
+    cfg = [(0, 0)] * x.ndim
+    cfg[1] = (0, T_pad - x.shape[1])
+    return jnp.pad(x, cfg)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _fused(zx, wh, h0, c0, mask, interpret):
+    out, _res = _fused_fwd(zx, wh, h0, c0, mask, interpret)
+    return out
+
+
+def _fwd_call(zx, wh, h0, c0, mask, interpret, tc):
+    B, T, Z = zx.shape
+    H = Z // 4
+    n_chunks = T // tc
+    kw = {}
+    if _VMEM is not None and not interpret:
+        kw["memory_space"] = _VMEM
+    blk_t = lambda ci: (0, ci, 0)        # noqa: E731
+    pin = lambda ci: (0, 0)              # noqa: E731
+    kernel = functools.partial(_fwd_kernel, tc=tc, H=H, n_chunks=n_chunks)
+    return pl.pallas_call(
+        kernel,
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec((B, tc, Z), blk_t, **kw),
+            pl.BlockSpec((H, Z), pin, **kw),
+            pl.BlockSpec((B, H), pin, **kw),
+            pl.BlockSpec((B, H), pin, **kw),
+            pl.BlockSpec((B, tc), lambda ci: (0, ci), **kw),
+        ],
+        out_specs=[
+            pl.BlockSpec((B, tc, H), blk_t, **kw),
+            pl.BlockSpec((B, tc, Z), blk_t, **kw),
+            pl.BlockSpec((B, tc, H), blk_t, **kw),
+            pl.BlockSpec((B, H), pin, **kw),
+            pl.BlockSpec((B, H), pin, **kw),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, T, H), zx.dtype),       # hs (carries)
+            # residuals in the INPUT precision: exact f32 when training
+            # f32, half-bandwidth when the model is bf16
+            jax.ShapeDtypeStruct((B, T, Z), zx.dtype),       # gate residuals
+            jax.ShapeDtypeStruct((B, T, H), zx.dtype),       # cell residuals
+            jax.ShapeDtypeStruct((B, H), zx.dtype),          # final h
+            jax.ShapeDtypeStruct((B, H), zx.dtype),          # final c
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((B, H), jnp.float32),
+            pltpu.VMEM((B, H), jnp.float32),
+        ] if pltpu is not None else [],
+        interpret=interpret,
+    )(zx, wh, h0, c0, mask)
+
+
+def _fused_fwd(zx, wh, h0, c0, mask, interpret):
+    B, T, Z = zx.shape
+    H = Z // 4
+    tc = _pick_chunk(T, B, H, jnp.dtype(zx.dtype).itemsize)
+    T_pad = ((T + tc - 1) // tc) * tc
+    zx_p = _pad_time(zx, T_pad)
+    m = jnp.ones((B, T), zx.dtype) if mask is None else mask.astype(zx.dtype)
+    m_p = _pad_time(m, T_pad)          # padded steps: mask 0 = carry freeze
+    hs, gates, cs, hT, cT = _fwd_call(zx_p, wh, h0, c0, m_p, interpret, tc)
+    hs = hs[:, :T]
+    out = hs * m[..., None] if mask is not None else hs
+    # zx itself is NOT a backward residual: the gates carry everything the
+    # reverse sweep needs (keeping zx alive would hold an extra [B,T,4H]
+    # HBM buffer across the step for nothing)
+    return ((out, (hT, cT)),
+            (gates[:, :T], wh, h0, c0, mask, hs, cs[:, :T]))
+
+
+def _bwd_call(gates, cs, cprev, hprev, wh, m, dhs, dcT, interpret, tc):
+    B, T, Z = gates.shape
+    H = Z // 4
+    n_chunks = T // tc
+    kw = {}
+    if _VMEM is not None and not interpret:
+        kw["memory_space"] = _VMEM
+    rev_t = lambda ci: (0, n_chunks - 1 - ci, 0)   # noqa: E731
+    rev_m = lambda ci: (0, n_chunks - 1 - ci)      # noqa: E731
+    pin = lambda ci: (0, 0)                        # noqa: E731
+    kernel = functools.partial(_bwd_kernel, tc=tc, H=H, n_chunks=n_chunks)
+    return pl.pallas_call(
+        kernel,
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec((B, tc, Z), rev_t, **kw),
+            pl.BlockSpec((B, tc, H), rev_t, **kw),
+            pl.BlockSpec((B, tc, H), rev_t, **kw),
+            pl.BlockSpec((B, tc, H), rev_t, **kw),
+            pl.BlockSpec((H, Z), pin, **kw),
+            pl.BlockSpec((B, tc), rev_m, **kw),
+            pl.BlockSpec((B, tc, H), rev_t, **kw),
+            pl.BlockSpec((B, H), pin, **kw),
+        ],
+        out_specs=[
+            pl.BlockSpec((B, tc, Z), rev_t, **kw),
+            pl.BlockSpec((H, Z), pin, **kw),
+            pl.BlockSpec((B, H), pin, **kw),
+            pl.BlockSpec((B, H), pin, **kw),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, T, Z), jnp.float32),    # dzx
+            jax.ShapeDtypeStruct((H, Z), jnp.float32),       # dWh
+            jax.ShapeDtypeStruct((B, H), jnp.float32),       # dh0
+            jax.ShapeDtypeStruct((B, H), jnp.float32),       # dc0
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((B, H), jnp.float32),
+            pltpu.VMEM((B, H), jnp.float32),
+            pltpu.VMEM((H, Z), jnp.float32),
+        ] if pltpu is not None else [],
+        interpret=interpret,
+    )(gates, cs, cprev, hprev, wh, m, dhs, dcT)
+
+
+def _fused_bwd(interpret, res, cts):
+    (dout, (dhT, dcT)) = cts
+    gates, wh, h0, c0, mask, hs, cs = res
+    zx_dtype = hs.dtype              # hs was emitted in zx's dtype
+    B, T, Z = gates.shape
+    H = Z // 4
+    tc = _pick_chunk(T, B, H, jnp.dtype(zx_dtype).itemsize)
+    T_pad = ((T + tc - 1) // tc) * tc
+
+    m = jnp.ones((B, T), jnp.float32) if mask is None \
+        else mask.astype(jnp.float32)
+    # the layer output is hs * m: fold m into the output cotangent, and
+    # seed the final-carry cotangents into the LAST timestep's carry slot
+    dhs = dout.astype(jnp.float32) * m[..., None]
+    # shifted carries: value entering step t
+    hprev = jnp.concatenate([h0.astype(hs.dtype)[:, None], hs[:, :-1]], 1)
+    cprev = jnp.concatenate([c0.astype(jnp.float32)[:, None],
+                             cs[:, :-1].astype(jnp.float32)], 1)
+
+    pad = lambda a: _pad_time(a, T_pad)
+    # the final-carry cotangents enter the reverse sweep exactly: h_T IS
+    # hs[:, T-1] (post-mask), so dhT folds into the last timestep's dhs
+    # row (the kernel adds dhs[t] to the carry WITHOUT the mask factor);
+    # dcT seeds the kernel's dc scratch at the first reverse chunk.
+    dhs = dhs.at[:, T - 1].add(dhT.astype(jnp.float32))
+    dzx_p, dwh, dh0, dc0 = _bwd_call(
+        pad(gates), pad(cs), pad(cprev), pad(hprev), wh,
+        pad(m), pad(dhs), dcT.astype(jnp.float32), interpret, tc)
+    dzx = dzx_p[:, :T]
+    return dzx.astype(zx_dtype), dwh.astype(wh.dtype), \
+        dh0.astype(h0.dtype), dc0.astype(c0.dtype), \
+        (jnp.zeros_like(mask) if mask is not None else None)
+
+
+_fused.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_lstm(zx, wh, h0, c0, mask=None, *, interpret: bool = False):
+    """Weight-stationary LSTM recurrence over precomputed input rows.
+
+    zx: [B, T, 4H] (= x @ Wx + b, gate order [i, f, g, o]);
+    wh: [H, 4H]; h0/c0: [B, H]; mask: optional [B, T] (masked steps carry
+    state through and output zeros — the framework's recurrent contract).
+    Returns (outputs [B, T, H], (h_T, c_T)). Differentiable (custom VJP,
+    blockwise Pallas backward); BOTH final-carry cotangents are exact —
+    dhT folds into the last timestep's output row, dcT seeds the reverse
+    sweep's dc scratch (test_fused_lstm.py differentiates through both).
+    """
+    if mask is not None:
+        mask = jnp.asarray(mask, jnp.float32)
+    return _fused(zx, wh, h0, c0, mask, interpret)

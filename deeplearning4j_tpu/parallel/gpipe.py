@@ -497,19 +497,20 @@ class GPipeTrainer:
 
         xspec = P(None, self.data_axis)
         if masks_all is not None:
-            mspec = P(None, None, self.data_axis, None)
-            return shard_map(
-                make_shard_fn(True),
-                mesh=self.mesh,
-                in_specs=(P(self.pipe_axis), xspec, P(), mspec),
-                out_specs=(xspec, P(self.pipe_axis)),
-            )(stacked, x_micro, rng, masks_all)
-        return shard_map(
-            make_shard_fn(False),
-            mesh=self.mesh,
-            in_specs=(P(self.pipe_axis), xspec, P()),
-            out_specs=(xspec, P(self.pipe_axis)),
-        )(stacked, x_micro, rng)
+            in_specs = (P(self.pipe_axis), xspec, P(),
+                        P(None, None, self.data_axis, None))
+            fn, args = make_shard_fn(True), (stacked, x_micro, rng, masks_all)
+        else:
+            in_specs = (P(self.pipe_axis), xspec, P())
+            fn, args = make_shard_fn(False), (stacked, x_micro, rng)
+        out_specs = (xspec, P(self.pipe_axis))
+        # NOTE: check_vma must stay ON here — _gpipe_shard's psum/ppermute
+        # ring depends on the varying-axes machinery. Pallas kernels (whose
+        # outputs carry no vma) therefore cannot run inside stages: the
+        # fused-LSTM dispatch is suppressed at trace time (see
+        # no_fused_lstm in fit_batch / nn/layers/recurrent.py).
+        return shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                         out_specs=out_specs)(*args)
 
     def _loss(self, params, x_micro, y_micro, rng, masks_all=None,
               head_mask=None):
@@ -663,13 +664,16 @@ class GPipeTrainer:
             xm = jnp.pad(xm, ((0, 0), (0, 0), (0, pad)))
         ym = jnp.asarray(y.reshape((self.n_micro, mb) + y.shape[1:]))
         self._rng, k = jax.random.split(self._rng)
+        from deeplearning4j_tpu.nn.layers.recurrent import no_fused_lstm
+
         args = ((self.stacked, self.head_params), self.opt_state,
                 self.bn_state, jnp.asarray(self.iteration, jnp.int32),
                 xm, ym, k)
         if fm is None and lm is None:
             if self._step is None:
                 self._step = self.make_train_step()
-            out = self._step(*args)
+            with no_fused_lstm():   # stage switch can't host pallas (vma)
+                out = self._step(*args)
         else:
             # mask channel (round 5): per-stage boundary masks ride into
             # the switch as one [S, M, mb, W] stack; the head scores with
@@ -693,7 +697,8 @@ class GPipeTrainer:
                 self._step_m = {}
             if key not in self._step_m:
                 self._step_m[key] = self.make_train_step()
-            out = self._step_m[key](*args, masks_all, head_mask)
+            with no_fused_lstm():   # stage switch can't host pallas (vma)
+                out = self._step_m[key](*args, masks_all, head_mask)
         ((self.stacked, self.head_params), self.opt_state, self.bn_state,
          loss) = out
         self.iteration += 1

@@ -1,0 +1,183 @@
+"""Weight-stationary fused LSTM (ops/fused_lstm.py) vs the lax.scan oracle.
+
+Interpret-mode equivalence (the dual-path pattern of SURVEY.md §4):
+forward, gradients (zx/Wh/h0/c0), masked semantics, multi-chunk grids,
+bf16, and the layer-level DL4J_TPU_FUSED_LSTM policy switch.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.fused_lstm import fused_lstm
+
+
+def _oracle(zx, wh, h0, c0, mask=None):
+    """The exact math of nn/layers/recurrent.py LSTM._cell_from_proj +
+    apply_seq's mask contract, written independently as a lax.scan."""
+    H = wh.shape[0]
+
+    def step(carry, inp):
+        h, c = carry
+        zx_t, m_t = inp
+        z = zx_t + h @ wh
+        i = jax.nn.sigmoid(z[:, :H])
+        f = jax.nn.sigmoid(z[:, H:2 * H])
+        g = jnp.tanh(z[:, 2 * H:3 * H])
+        o = jax.nn.sigmoid(z[:, 3 * H:])
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        if m_t is not None:
+            mm = m_t[:, None]
+            h_new = mm * h_new + (1 - mm) * h
+            c_new = mm * c_new + (1 - mm) * c
+            out = h_new * mm
+        else:
+            out = h_new
+        return (h_new, c_new), out
+
+    T = zx.shape[1]
+    xs = jnp.swapaxes(zx, 0, 1)
+    if mask is None:
+        (hT, cT), outs = jax.lax.scan(
+            lambda c, v: step(c, (v, None)), (h0, c0), xs)
+    else:
+        ms = jnp.swapaxes(mask, 0, 1)
+        (hT, cT), outs = jax.lax.scan(step, (h0, c0), (xs, ms))
+    return jnp.swapaxes(outs, 0, 1), (hT, cT)
+
+
+def _rand(rs, *shape):
+    return jnp.asarray(rs.randn(*shape).astype(np.float32) * 0.3)
+
+
+CFGS = [(2, 6, 128), (3, 10, 128), (2, 5, 256)]
+
+
+class TestForward:
+    @pytest.mark.parametrize("B,T,H", CFGS)
+    def test_matches_oracle(self, B, T, H):
+        rs = np.random.RandomState(0)
+        zx, wh = _rand(rs, B, T, 4 * H), _rand(rs, H, 4 * H)
+        h0, c0 = _rand(rs, B, H), _rand(rs, B, H)
+        out, (hT, cT) = fused_lstm(zx, wh, h0, c0, interpret=True)
+        ref, (hr, cr) = _oracle(zx, wh, h0, c0)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(hT), np.asarray(hr),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(cT), np.asarray(cr),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_masked_matches_oracle(self):
+        rs = np.random.RandomState(1)
+        B, T, H = 3, 8, 128
+        zx, wh = _rand(rs, B, T, 4 * H), _rand(rs, H, 4 * H)
+        h0, c0 = _rand(rs, B, H), _rand(rs, B, H)
+        lens = np.array([8, 5, 2])
+        m = jnp.asarray((np.arange(T)[None] < lens[:, None]).astype(np.float32))
+        out, (hT, cT) = fused_lstm(zx, wh, h0, c0, m, interpret=True)
+        ref, (hr, cr) = _oracle(zx, wh, h0, c0, m)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(hT), np.asarray(hr),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(cT), np.asarray(cr),
+                                   rtol=2e-4, atol=2e-4)
+
+
+class TestBackward:
+    @pytest.mark.parametrize("B,T,H", CFGS)
+    def test_grads_match_oracle(self, B, T, H):
+        rs = np.random.RandomState(2)
+        zx, wh = _rand(rs, B, T, 4 * H), _rand(rs, H, 4 * H)
+        h0, c0 = _rand(rs, B, H), _rand(rs, B, H)
+
+        def loss_f(zx, wh, h0, c0):
+            out, (hT, cT) = fused_lstm(zx, wh, h0, c0, interpret=True)
+            return jnp.sum(out ** 2) + jnp.sum(hT * 0.5) + jnp.sum(cT * 0.25)
+
+        def loss_o(zx, wh, h0, c0):
+            out, (hT, cT) = _oracle(zx, wh, h0, c0)
+            return jnp.sum(out ** 2) + jnp.sum(hT * 0.5) + jnp.sum(cT * 0.25)
+
+        gf = jax.grad(loss_f, argnums=(0, 1, 2, 3))(zx, wh, h0, c0)
+        go = jax.grad(loss_o, argnums=(0, 1, 2, 3))(zx, wh, h0, c0)
+        for a, b, name in zip(gf, go, ("dzx", "dWh", "dh0", "dc0")):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-4, err_msg=name)
+
+    def test_masked_grads_match_oracle(self):
+        rs = np.random.RandomState(3)
+        B, T, H = 2, 6, 128
+        zx, wh = _rand(rs, B, T, 4 * H), _rand(rs, H, 4 * H)
+        h0, c0 = _rand(rs, B, H), _rand(rs, B, H)
+        m = jnp.asarray(np.array([[1, 1, 1, 0, 0, 0],
+                                  [1, 1, 1, 1, 1, 0]], np.float32))
+
+        def loss(fn):
+            def go(zx, wh, h0, c0):
+                out, (hT, cT) = fn(zx, wh, h0, c0, m)
+                return jnp.sum(out ** 2) + jnp.sum(hT) + jnp.sum(cT * 0.5)
+            return go
+
+        fused = lambda *a: fused_lstm(*a, interpret=True)
+        gf = jax.grad(loss(fused), argnums=(0, 1, 2, 3))(zx, wh, h0, c0)
+        go = jax.grad(loss(_oracle), argnums=(0, 1, 2, 3))(zx, wh, h0, c0)
+        for a, b, name in zip(gf, go, ("dzx", "dWh", "dh0", "dc0")):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-4, err_msg=name)
+
+    def test_bf16_finite_and_close(self):
+        rs = np.random.RandomState(4)
+        B, T, H = 2, 4, 128
+        zx = _rand(rs, B, T, 4 * H).astype(jnp.bfloat16)
+        wh = _rand(rs, H, 4 * H).astype(jnp.bfloat16)
+        h0 = jnp.zeros((B, H), jnp.bfloat16)
+        c0 = jnp.zeros((B, H), jnp.bfloat16)
+        out, _ = fused_lstm(zx, wh, h0, c0, interpret=True)
+        ref, _ = _oracle(zx.astype(jnp.float32), wh.astype(jnp.float32),
+                         h0.astype(jnp.float32), c0.astype(jnp.float32))
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref), rtol=5e-2, atol=5e-2)
+        g = jax.grad(lambda z: jnp.sum(fused_lstm(
+            z, wh, h0, c0, interpret=True)[0].astype(jnp.float32) ** 2))(zx)
+        assert np.all(np.isfinite(np.asarray(g, np.float32)))
+
+
+class TestLayerPolicy:
+    def test_forced_fused_matches_scan_layer(self):
+        """DL4J_TPU_FUSED_LSTM=1 routes the LSTM layer through the kernel
+        (interpreter off-TPU) and must match the default scan path."""
+        from deeplearning4j_tpu.nn.input_type import InputType
+        from deeplearning4j_tpu.nn.layers.recurrent import LSTM
+
+        rs = np.random.RandomState(5)
+        layer = LSTM(n_out=128)
+        params = layer.init(jax.random.PRNGKey(0), InputType.recurrent(16, 6))
+        x = jnp.asarray(rs.randn(2, 6, 16).astype(np.float32))
+        old = os.environ.get("DL4J_TPU_FUSED_LSTM")
+        try:
+            os.environ["DL4J_TPU_FUSED_LSTM"] = "0"
+            y_scan, _ = layer.apply(params, {}, x)
+            os.environ["DL4J_TPU_FUSED_LSTM"] = "1"
+            y_fused, _ = layer.apply(params, {}, x)
+        finally:
+            if old is None:
+                os.environ.pop("DL4J_TPU_FUSED_LSTM", None)
+            else:
+                os.environ["DL4J_TPU_FUSED_LSTM"] = old
+        np.testing.assert_allclose(np.asarray(y_fused), np.asarray(y_scan),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_ineligible_configs_fall_back(self):
+        from deeplearning4j_tpu.nn.layers.recurrent import LSTM, GravesLSTM
+
+        assert not LSTM(n_out=100)._fused_eligible()          # lane-unaligned
+        assert not LSTM(n_out=128, activation="relu")._fused_eligible()
+        assert not GravesLSTM(n_out=128)._fused_eligible()    # peepholes
+        assert LSTM(n_out=256)._fused_eligible()
