@@ -147,6 +147,17 @@ class LayerConfig:
     #   {"type": "gaussian", "stddev": 0.01, "additive": true}
     weight_noise: Optional[dict] = None
 
+    def uses_rng(self) -> bool:
+        """Does a TRAIN-mode apply() draw randomness? Layers with extra
+        noise sources (GaussianNoise/GaussianDropout, attention dropout)
+        extend this; wrapper layers are covered generically via their
+        ``rnn`` attribute. Drives the chained-fit auto gate
+        (MultiLayerNetwork._chain_k): only rng-free models chain by
+        default, so the per-step rng stream is never silently changed."""
+        inner = getattr(self, "rnn", None)
+        return (bool(self.dropout) or self.weight_noise is not None
+                or (inner is not None and inner.uses_rng()))
+
     # -- registry / serde --------------------------------------------------
     _type_name = "base"
 

@@ -79,6 +79,9 @@ class MultiHeadAttention(LayerConfig):
     def output_type(self, input_type: InputType) -> InputType:
         return input_type
 
+    def uses_rng(self) -> bool:
+        return super().uses_rng() or self.attn_dropout > 0.0
+
     def init(self, key, input_type, dtype=jnp.float32):
         C = input_type.size
         if C % self.n_heads:

@@ -245,6 +245,9 @@ class GaussianNoise(LayerConfig):
 
     stddev: float = 0.1
 
+    def uses_rng(self) -> bool:
+        return super().uses_rng() or self.stddev > 0.0
+
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         if not train or self.stddev <= 0.0:
             return x, state
@@ -260,6 +263,9 @@ class GaussianDropout(LayerConfig):
     x * N(1, rate/(1-rate))."""
 
     rate: float = 0.5
+
+    def uses_rng(self) -> bool:
+        return super().uses_rng() or self.rate > 0.0
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         if not train or self.rate <= 0.0:

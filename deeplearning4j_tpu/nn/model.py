@@ -347,6 +347,9 @@ class MultiLayerNetwork:
 
     # -- jitted step -------------------------------------------------------
     def _make_step(self, with_carries: bool):
+        return jax.jit(self._step_body(with_carries), donate_argnums=(0, 1, 2))
+
+    def _step_body(self, with_carries: bool):
         updaters = self._updaters
         layers = self.layers
 
@@ -387,7 +390,38 @@ class MultiLayerNetwork:
                 new_opt.append(new_s)
             return tuple(new_params), tuple(new_opt), new_state, new_carries, loss
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return step
+
+    def _make_chain_step(self):
+        """K train steps per DISPATCH: lax.scan of the step body over
+        stacked [K, B, ...] minibatches. Small models are dispatch-bound
+        (a ~4 ms host->device floor per call through remote links —
+        docs/PERF.md LeNet); one dispatch covering K steps amortizes it.
+        Per-step rngs derive as fold_in(rng, i) — identical math to the
+        per-step path for models that draw no randomness (no dropout /
+        weight noise), a different-but-equivalent stream otherwise."""
+        body_step = self._step_body(False)
+
+        def chain(params, opt_state, state, it0, rng, xs, ys):
+            def body(carry, inp):
+                p, o, s, i = carry
+                x, y = inp
+                k = jax.random.fold_in(rng, i)
+                p, o, s, _, loss = body_step(p, o, s, it0 + i, k, x, y,
+                                             None, None, ())
+                return (p, o, s, i + 1), loss
+
+            (p, o, s, _), losses = jax.lax.scan(
+                body, (params, opt_state, state, jnp.asarray(0, jnp.int32)),
+                (xs, ys))
+            return p, o, s, losses
+
+        return jax.jit(chain, donate_argnums=(0, 1, 2))
+
+    def _get_chain_step(self):
+        if getattr(self, "_chain_step_fn", None) is None:
+            self._chain_step_fn = self._make_chain_step()
+        return self._chain_step_fn
 
     def _get_step_fn(self, with_carries: bool):
         if with_carries:
@@ -403,6 +437,33 @@ class MultiLayerNetwork:
         self.listeners = list(listeners)
         return self
 
+    def _chain_k(self) -> int:
+        """Steps chained per dispatch in fit()'s hot loop (0 = per-step).
+        DL4J_TPU_CHAIN_STEPS forces a count; "auto" chains 8 only for
+        models that draw NO randomness (identical math to per-step) and
+        are small enough to be dispatch-bound (docs/PERF.md LeNet)."""
+        import os as _os
+
+        env = _os.environ.get("DL4J_TPU_CHAIN_STEPS", "auto")
+        if env != "auto":
+            try:
+                return max(int(env), 0)
+            except ValueError:
+                return 0
+        uses_rng = any(l.uses_rng() for l in self.layers)
+        return 8 if (not uses_rng and self.num_params() < 2_000_000) else 0
+
+    def _fit_chained(self, buf) -> None:
+        """One dispatch covering len(buf) train steps (lax.scan of the step
+        body over stacked minibatches)."""
+        chain = self._get_chain_step()
+        xs = jnp.stack([_cast_input(x, self.dtype) for x, _ in buf])
+        ys = jnp.stack([_cast_labels(y, self.dtype) for _, y in buf])
+        self.params, self.opt_state, self.state, _ = chain(
+            self.params, self.opt_state, self.state,
+            jnp.asarray(self.iteration, jnp.int32), self._next_rng(), xs, ys)
+        self.iteration += len(buf)
+
     def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None):
         """Train. ``data``: (x, y[, fmask[, lmask]]) arrays, an iterable of
         such batches, or a callable returning a fresh iterable per epoch
@@ -410,14 +471,38 @@ class MultiLayerNetwork:
         if self.params is None:
             self.init()
         tbptt = self.conf.backprop_type == "tbptt"
+        sgd = self.conf.optimization_algo in (
+            "stochastic_gradient_descent", "sgd")
+        chain_k = self._chain_k() if sgd and not self.listeners else 0
         for _ in range(epochs):
             for l in self.listeners:
                 l.on_epoch_start(self, self.epoch)
             source = data() if callable(data) else data
+            buf: list = []
+
+            def flush(full: bool):
+                # full K-groups go out as ONE dispatch; tails use the
+                # per-step path (a different K would be a fresh compile)
+                if full and len(buf) > 1:
+                    self._fit_chained(buf)
+                else:
+                    for bx, by in buf:
+                        self._fit_batch(bx, by, None, None)
+                buf.clear()
+
             for x, y, fm, lm in _iter_batches(source, batch_size):
-                if self.conf.optimization_algo not in (
-                    "stochastic_gradient_descent", "sgd"
-                ):
+                chainable = (
+                    chain_k > 1 and fm is None and lm is None
+                    and not (tbptt and np.ndim(x) == 3)
+                    and (not buf or np.shape(x) == np.shape(buf[0][0]))
+                )
+                if chainable:
+                    buf.append((x, y))
+                    if len(buf) == chain_k:
+                        flush(True)
+                    continue
+                flush(False)
+                if not sgd:
                     score = self._fit_solver(x, y, fm, lm)
                 elif tbptt and np.ndim(x) == 3:
                     score = self._fit_tbptt(x, y, fm, lm)
@@ -429,6 +514,7 @@ class MultiLayerNetwork:
                     score = float(score)
                     for l in self.listeners:
                         l.iteration_done(self, self.iteration, score, len(x))
+            flush(False)
             for l in self.listeners:
                 l.on_epoch_end(self, self.epoch)
             self.epoch += 1

@@ -472,3 +472,82 @@ class TestRnnInputProjectionHoist:
                         jax.tree_util.tree_leaves(c_slow)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-5, atol=1e-6)
+
+
+class TestChainedFit:
+    """Round-5 (VERDICT r4 #9): fit() chains K steps per dispatch for
+    small rng-free models — identical math to the per-step path."""
+
+    @staticmethod
+    def _conf():
+        return MultiLayerConfiguration(
+            layers=(Dense(n_out=10, activation="tanh"),
+                    OutputLayer(n_out=3, activation="softmax")),
+            input_type=InputType.feed_forward(4),
+            updater={"type": "adam", "lr": 0.01}, seed=5)
+
+    def test_chained_equals_per_step_exactly(self):
+        import os
+        rs = np.random.RandomState(0)
+        x = rs.rand(64, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 64)]
+
+        old = os.environ.get("DL4J_TPU_CHAIN_STEPS")
+        try:
+            os.environ["DL4J_TPU_CHAIN_STEPS"] = "0"
+            m_ref = MultiLayerNetwork(self._conf()).init()
+            m_ref.fit((x, y), epochs=4, batch_size=8)   # 8 batches/epoch
+            os.environ["DL4J_TPU_CHAIN_STEPS"] = "4"
+            m_ch = MultiLayerNetwork(self._conf()).init()
+            m_ch.fit((x, y), epochs=4, batch_size=8)
+        finally:
+            if old is None:
+                os.environ.pop("DL4J_TPU_CHAIN_STEPS", None)
+            else:
+                os.environ["DL4J_TPU_CHAIN_STEPS"] = old
+        assert m_ch.iteration == m_ref.iteration == 32
+        for a, b in zip(jax.tree_util.tree_leaves(m_ch.params),
+                        jax.tree_util.tree_leaves(m_ref.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
+
+    def test_auto_chain_skips_dropout_models(self):
+        conf = MultiLayerConfiguration(
+            layers=(Dense(n_out=8, activation="tanh", dropout=0.5),
+                    OutputLayer(n_out=3, activation="softmax")),
+            input_type=InputType.feed_forward(4), seed=1)
+        m = MultiLayerNetwork(conf).init()
+        assert m._chain_k() == 0      # randomness -> per-step stream kept
+
+    def test_auto_chain_enables_for_small_rng_free(self):
+        m = MultiLayerNetwork(self._conf()).init()
+        assert m._chain_k() == 8
+
+    def test_uneven_tail_still_trains(self):
+        rs = np.random.RandomState(2)
+        x = rs.rand(30, 4).astype(np.float32)   # 3 full batches + tail of 6
+        y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 30)]
+        m = MultiLayerNetwork(self._conf()).init()
+        s0 = m.score(x, y)
+        m.fit((x, y), epochs=6, batch_size=8)
+        assert m.iteration == 6 * 4
+        assert m.score(x, y) < s0
+
+    def test_auto_chain_skips_all_noise_layers(self):
+        from deeplearning4j_tpu.nn.layers.core import (
+            GaussianDropout, GaussianNoise)
+        from deeplearning4j_tpu.nn.layers.recurrent import Bidirectional, SimpleRnn
+
+        for noisy in (GaussianNoise(stddev=0.1), GaussianDropout(rate=0.3)):
+            conf = MultiLayerConfiguration(
+                layers=(Dense(n_out=8), noisy,
+                        OutputLayer(n_out=3, activation="softmax")),
+                input_type=InputType.feed_forward(4), seed=1)
+            assert MultiLayerNetwork(conf).init()._chain_k() == 0, type(noisy)
+        # wrapper with a dropout-carrying inner rnn
+        conf = MultiLayerConfiguration(
+            layers=(Bidirectional(rnn=SimpleRnn(n_out=4, dropout=0.2)),
+                    Dense(n_out=4),
+                    OutputLayer(n_out=2, activation="softmax")),
+            input_type=InputType.recurrent(3, 5), seed=1)
+        assert MultiLayerNetwork(conf).init()._chain_k() == 0
