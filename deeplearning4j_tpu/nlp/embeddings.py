@@ -72,7 +72,8 @@ def _sg_ns_step(params, centers, contexts, negs, lr):
 
 
 def _sg_ns_epoch_scan(params, centers2d, contexts2d, cum_table, key,
-                      lr0, min_lr, seen0, total, negative: int):
+                      lr0, min_lr, seen0, total, negative: int,
+                      unroll: int = 4):
     """lax.scan of _sg_ns_step over [N, B] pair chunks, negatives drawn
     ON-DEVICE by inverse-CDF over the unigram table. One dispatch (and ONE
     host->device transfer of the pair arrays) covers N batches — through a
@@ -92,12 +93,15 @@ def _sg_ns_epoch_scan(params, centers2d, contexts2d, cum_table, key,
         prm, loss = _sg_ns_step(prm, c, t, negs, lr)
         return (prm, k, seen + B), loss
 
-    # unroll=4: scan-of-scatter on TPU runs ~4x faster partially unrolled
-    # (measured 283 -> 64 ms/step at B=64K, V=100K; unroll=16 is no better
-    # and triples compile time)
+    # unroll=4 default: scan-of-scatter on TPU runs ~4x faster partially
+    # unrolled (measured 283 -> 64 ms/step at B=64K, V=100K; unroll=16 is
+    # no better and triples compile time). unroll=1 ~halves the first-epoch
+    # compile (52.2s at the bench config, BENCH_r04) at ~4x warm-epoch cost
+    # -- or keep 4 and amortize compiles across processes with
+    # utils/compile_cache.enable_compilation_cache.
     (params, _, _), losses = jax.lax.scan(
         body, (params, key, jnp.asarray(seen0, jnp.float32)),
-        (centers2d, contexts2d), unroll=4)
+        (centers2d, contexts2d), unroll=unroll)
     return params, losses
 
 
@@ -406,6 +410,10 @@ class SequenceVectors:
         # unigram table — same distribution as the host draw).
         pair_backend: str = "python",
         scan_batches: int = 64,
+        # epoch-scan unroll factor: 4 = fastest warm epoch; 1 = ~halved
+        # first-epoch XLA compile (see utils/compile_cache for the
+        # cross-process amortization alternative)
+        scan_unroll: int = 4,
     ):
         self.layer_size = layer_size
         self.window = window
@@ -422,8 +430,11 @@ class SequenceVectors:
             raise ValueError(f"pair_backend must be 'python' or 'numpy', got {pair_backend!r}")
         if scan_batches < 1:
             raise ValueError(f"scan_batches must be >= 1, got {scan_batches}")
+        if scan_unroll < 1:
+            raise ValueError(f"scan_unroll must be >= 1, got {scan_unroll}")
         self.pair_backend = pair_backend
         self.scan_batches = scan_batches
+        self.scan_unroll = scan_unroll
         self.seed = seed
         self.vocab: Optional[VocabCache] = None
         self.params: Optional[dict] = None
@@ -533,7 +544,7 @@ class SequenceVectors:
                 if "sg_ns_scan" not in self._step_cache:
                     self._step_cache["sg_ns_scan"] = jax.jit(
                         _sg_ns_epoch_scan, donate_argnums=(0,),
-                        static_argnames=("negative",))
+                        static_argnames=("negative", "unroll"))
                 scan_step = self._step_cache["sg_ns_scan"]
                 if cum_dev is None:
                     cum_dev = jnp.asarray(np.cumsum(table), jnp.float32)
@@ -558,7 +569,8 @@ class SequenceVectors:
                             cum, key, jnp.asarray(self.lr, jnp.float32),
                             jnp.asarray(self.min_lr, jnp.float32),
                             float(seen), float(total_pairs_est),
-                            negative=self.negative)
+                            negative=self.negative,
+                            unroll=self.scan_unroll)
                         seen += len(cc)
                     else:
                         tail_c.append(cc)

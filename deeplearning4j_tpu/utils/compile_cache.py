@@ -1,0 +1,53 @@
+"""Persistent XLA compilation cache — first-epoch compile amortization.
+
+Big unrolled programs (the Word2Vec epoch scan: 52.2s of compiles on the
+first epoch, ~5x a warm epoch — BENCH_r04 end_to_end_split_sec; the
+transformer/flash kernels: 20-40s each) recompile from scratch in every
+fresh process. JAX ships a persistent on-disk cache that keys compiled
+executables by HLO fingerprint; enabling it makes the SECOND process's
+first epoch warm.
+
+Opt-in (global config mutation should never happen on library import):
+
+    from deeplearning4j_tpu.utils.compile_cache import enable_compilation_cache
+    enable_compilation_cache()            # ~/.cache/deeplearning4j_tpu/xla
+
+or set ``DL4J_TPU_COMPILE_CACHE=/path`` (empty value = the default dir)
+and call ``enable_compilation_cache_from_env()`` — bench.py does this so
+driver re-runs skip the Word2Vec scan compile.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_DEFAULT = os.path.join(os.path.expanduser("~"), ".cache",
+                        "deeplearning4j_tpu", "xla")
+
+
+def enable_compilation_cache(cache_dir: Optional[str] = None,
+                             min_compile_time_secs: float = 1.0) -> str:
+    """Point JAX's persistent compilation cache at ``cache_dir`` (created
+    if missing). Only compiles slower than ``min_compile_time_secs`` are
+    persisted — the long-pole scans/kernels, not trivial jits."""
+    import jax
+
+    path = cache_dir or _DEFAULT
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    try:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_time_secs))
+    except AttributeError:  # older jax: flag absent; cache still works
+        pass
+    return path
+
+
+def enable_compilation_cache_from_env() -> Optional[str]:
+    """Enable the cache iff DL4J_TPU_COMPILE_CACHE is set (empty value =
+    default location). Returns the directory or None."""
+    val = os.environ.get("DL4J_TPU_COMPILE_CACHE")
+    if val is None:
+        return None
+    return enable_compilation_cache(val or None)

@@ -360,7 +360,7 @@ class TestEpochScanPath:
         # counters inside jit would only record traces)
         seen_counts = []
         real_scan = _jax.jit(E._sg_ns_epoch_scan, donate_argnums=(0,),
-                             static_argnames=("negative",))
+                             static_argnames=("negative", "unroll"))
         real_step = _jax.jit(E._sg_ns_step, donate_argnums=(0,))
 
         def scan_wrapper(params, c2, t2, *a, **k):
