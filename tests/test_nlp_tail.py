@@ -375,3 +375,91 @@ class TestEpochScanPath:
         m._step_cache["sg_ns"] = step_wrapper
         m._run_epochs(idx_seqs, 1)
         assert sum(seen_counts) == expected, (seen_counts, expected)
+
+
+class TestCJKMorphology:
+    """Round-5: lattice Viterbi CJK segmentation (nlp/cjk.py) — converts
+    the char-bigram-only CJK row to genuine dictionary-driven morphology
+    at a documented reduced-lexicon scope."""
+
+    def test_chinese_lattice_segments_words(self):
+        from deeplearning4j_tpu.nlp.cjk import ChineseTokenizerFactory
+
+        tf = ChineseTokenizerFactory()
+        toks = tf.tokenize("我们喜欢机器学习")
+        assert toks == ["我们", "喜欢", "机器", "学习"]
+
+    def test_chinese_user_dict_wins(self):
+        from deeplearning4j_tpu.nlp.cjk import ChineseTokenizerFactory
+
+        base = ChineseTokenizerFactory().tokenize("机器学习")
+        assert base == ["机器", "学习"]
+        tf = ChineseTokenizerFactory(user_dict=["机器学习"])
+        assert tf.tokenize("机器学习") == ["机器学习"]
+
+    def test_japanese_particles_split_katakana_groups(self):
+        from deeplearning4j_tpu.nlp.cjk import JapaneseTokenizerFactory
+
+        tf = JapaneseTokenizerFactory()
+        toks = tf.tokenize("私はデータを見る")
+        assert toks == ["私", "は", "データ", "を", "見る"]
+
+    def test_japanese_unknown_katakana_run_groups(self):
+        from deeplearning4j_tpu.nlp.cjk import JapaneseTokenizerFactory
+
+        toks = JapaneseTokenizerFactory().tokenize("トランスフォーマーの研究")
+        assert toks[0] == "トランスフォーマー"   # loan-word run stays whole
+        assert "の" in toks and "研究" in toks
+
+    def test_korean_josa_split(self):
+        from deeplearning4j_tpu.nlp.cjk import KoreanTokenizerFactory
+
+        tf = KoreanTokenizerFactory()
+        toks = tf.tokenize("학교에서 공부")
+        assert toks == ["학교", "에서", "공부"]
+
+    def test_korean_unknown_stem_josa_stripped(self):
+        from deeplearning4j_tpu.nlp.cjk import KoreanTokenizerFactory
+
+        toks = KoreanTokenizerFactory().tokenize("텐서가 크다")
+        assert "텐서" in toks and "가" in toks and "크다" in toks
+
+    def test_mixed_scripts_and_latin_pass_through(self):
+        from deeplearning4j_tpu.nlp.cjk import ChineseTokenizerFactory
+
+        toks = ChineseTokenizerFactory().tokenize("我用GPT4学习中文!")
+        assert "GPT4" in toks and "中国" not in toks
+        assert "学习" in toks and ("中文" in toks or "中" in toks)
+
+    def test_unknown_han_never_fails(self):
+        from deeplearning4j_tpu.nlp.cjk import LatticeSegmenter
+
+        seg = LatticeSegmenter({})
+        out = seg.segment("魑魅魍魎")
+        assert "".join(out) == "魑魅魍魎" and out
+
+    def test_word2vec_integration(self):
+        from deeplearning4j_tpu.nlp.cjk import ChineseTokenizerFactory
+        from deeplearning4j_tpu.nlp.embeddings import Word2Vec
+
+        sentences = ["我们喜欢机器学习", "老师喜欢学生", "学生学习汉语"] * 10
+        m = Word2Vec(layer_size=8, window=2, negative=2, min_word_frequency=1,
+                     epochs=1, batch_size=32, seed=3,
+                     tokenizer_factory=ChineseTokenizerFactory())
+        m.fit(sentences)
+        assert m.has_word("学习") and m.has_word("喜欢")
+        assert m.get_word_vector("学习").shape == (8,)
+
+    def test_factory_surface_matches_default(self):
+        """Drop-in interchangeable with DefaultTokenizerFactory: create /
+        tokenize / set_token_pre_processor."""
+        from deeplearning4j_tpu.nlp.cjk import JapaneseTokenizerFactory
+
+        tf = JapaneseTokenizerFactory().set_token_pre_processor(
+            lambda t: t if t != "は" else "")
+        tk = tf.create("私は行く")
+        out = []
+        while tk.has_more_tokens():
+            out.append(tk.next_token())
+        assert out == ["私", "行く"]
+        assert tk.count_tokens() == 2
