@@ -16,7 +16,13 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Sequence, Tuple
 
+from deeplearning4j_tpu.ui.i18n import I18N
 from deeplearning4j_tpu.ui.storage import StatsStorage
+
+
+def _msg(key: str, lang=None) -> str:
+    """Localized UI chrome string (ui/i18n.py, DefaultI18N parity)."""
+    return I18N.get_instance().get_message(key, lang)
 
 _W, _H, _PAD = 640, 220, 42
 
@@ -161,27 +167,33 @@ class UIServer:
         return storage
 
     # -- rendering ---------------------------------------------------------
-    def render_html(self, refresh_seconds: int = 0) -> str:
+    def render_html(self, refresh_seconds: int = 0,
+                    lang: Optional[str] = None) -> str:
         """``refresh_seconds > 0`` makes the page LIVE: served pages carry a
         meta-refresh so the dashboard re-renders from storage while training
-        runs (reference module/train/TrainModule.java live updates)."""
+        runs (reference module/train/TrainModule.java live updates).
+        ``lang`` localizes the chrome via ui/i18n.py (DefaultI18N parity;
+        served pages take ``?lang=ja`` etc.)."""
+        msg = lambda k: _msg(k, lang)
         refresh = (f"<meta http-equiv='refresh' content='{refresh_seconds}'>"
                    if refresh_seconds > 0 else "")
         parts = [f"<html><head><meta charset='utf-8'>{refresh}"
                  f"<style>{_CSS}</style>"
-                 "<title>deeplearning4j_tpu training UI</title></head><body>"
-                 "<h1>Training overview</h1>"]
+                 f"<title>{html.escape(msg('train.pagetitle'))}</title></head><body>"
+                 f"<h1>{html.escape(msg('train.overview.title'))}</h1>"]
         for storage in self.storages:
             for sid in storage.list_session_ids():
-                parts.append(self._render_session(storage, sid))
+                parts.append(self._render_session(storage, sid, lang))
         parts.append("</body></html>")
         return "".join(parts)
 
-    def _render_session(self, storage: StatsStorage, sid: str) -> str:
+    def _render_session(self, storage: StatsStorage, sid: str,
+                        lang: Optional[str] = None) -> str:
+        msg = lambda k: _msg(k, lang)
         ups = [u for u in storage.get_all_updates(sid)
                if u.get("type_id") == "StatsReport"]
         statics = storage.get_static_info(sid)
-        parts = [f"<h2>Session {html.escape(sid)}</h2>"]
+        parts = [f"<h2>{html.escape(msg('train.session'))} {html.escape(sid)}</h2>"]
         if statics:
             s = statics[0]
             rows = "".join(
@@ -193,28 +205,29 @@ class UIServer:
         if not ups:
             return "".join(parts)
         its = [u["iteration"] for u in ups]
-        parts.append(_chart("Score vs iteration", [("score", its, [u["score"] for u in ups])]))
+        parts.append(_chart(msg("train.overview.chart.score"),
+                            [("score", its, [u["score"] for u in ups])]))
         tput = [(u["iteration"], u["samples_per_sec"]) for u in ups
                 if u.get("samples_per_sec")]
         if tput:
-            parts.append(_chart("Throughput (samples/sec)",
+            parts.append(_chart(msg("train.overview.chart.throughput"),
                                 [("samples/sec", [t[0] for t in tput], [t[1] for t in tput])]))
         pnames = sorted(ups[-1].get("parameters", {}).keys())
         if pnames:
             parts.append(_chart(
-                "Parameter L2 norms",
+                msg("train.model.chart.l2norm"),
                 [(n, its, [u["parameters"].get(n, {}).get("norm2", 0.0) for u in ups])
                  for n in pnames],
             ))
             ratio_ups = [u for u in ups if u.get("update_ratios")]
             if ratio_ups:
                 parts.append(_chart(
-                    "Update/parameter ratio (learning-rate health)",
+                    msg("train.model.chart.updateratio"),
                     [(n, [u["iteration"] for u in ratio_ups],
                       [u["update_ratios"].get(n, 0.0) for u in ratio_ups])
                      for n in pnames],
                 ))
-            parts.append("<h2>Weight histograms (latest iteration)</h2>")
+            parts.append(f"<h2>{html.escape(msg('train.model.histograms'))}</h2>")
             for n in pnames:
                 hg = ups[-1]["parameters"][n].get("histogram")
                 if hg:
@@ -245,16 +258,17 @@ class UIServer:
         )
         return self
 
-    def render_tsne_html(self) -> str:
+    def render_tsne_html(self, lang: Optional[str] = None) -> str:
+        msg = lambda k: _msg(k, lang)
+        title = html.escape(msg("tsne.title"))
         parts = [f"<html><head><meta charset='utf-8'><style>{_CSS}</style>"
-                 "<title>t-SNE embeddings</title></head><body>"
-                 "<h1>t-SNE embeddings</h1>"]
+                 f"<title>{title}</title></head><body>"
+                 f"<h1>{title}</h1>"]
         if not self._tsne_sets:
-            parts.append("<p>No embeddings uploaded — POST JSON "
-                         "{\"coords\": [[x,y]...], \"labels\": [...]} to "
-                         "/tsne, or call UIServer.upload_tsne().</p>")
+            parts.append(f"<p>{html.escape(msg('tsne.empty'))}</p>")
         for sid, (coords, labels) in sorted(self._tsne_sets.items()):
-            parts.append(f"<h2>{html.escape(sid)} ({len(coords)} points)</h2>")
+            parts.append(f"<h2>{html.escape(sid)} ({len(coords)} "
+                         f"{html.escape(msg('tsne.points'))})</h2>")
             parts.append(_scatter_svg(coords, labels))
         parts.append("</body></html>")
         return "".join(parts)
@@ -268,15 +282,22 @@ class UIServer:
                 pass
 
             def do_GET(self):
-                if self.path in ("/", "/train", "/train/overview"):
+                from urllib.parse import parse_qs, urlparse
+
+                parsed = urlparse(self.path)
+                route = parsed.path
+                # ?lang=ja etc. (DefaultI18N setDefaultLanguage per request)
+                lang = (parse_qs(parsed.query).get("lang") or [None])[0]
+                if route in ("/", "/train", "/train/overview"):
                     # served pages are live: re-rendered per request + a
                     # 5s meta-refresh so the browser polls while training
-                    body = outer.render_html(refresh_seconds=5).encode()
+                    body = outer.render_html(refresh_seconds=5,
+                                             lang=lang).encode()
                     ctype = "text/html"
-                elif self.path == "/tsne":
-                    body = outer.render_tsne_html().encode()
+                elif route == "/tsne":
+                    body = outer.render_tsne_html(lang=lang).encode()
                     ctype = "text/html"
-                elif self.path == "/stats":
+                elif route == "/stats":
                     body = json.dumps([
                         {"sessions": st.list_session_ids()} for st in outer.storages
                     ]).encode()

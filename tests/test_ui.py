@@ -160,3 +160,67 @@ class TestTsnePage:
         srv = UIServer().upload_tsne(emb, labels=[f"w{i}" for i in range(20)])
         page = srv.render_tsne_html()
         assert page.count("<circle") == 20
+
+
+class TestI18N:
+    """DefaultI18N parity (ui/i18n.py): language packs, fallback, resource
+    files, and the served pages' ?lang= switch."""
+
+    def test_message_lookup_and_fallback(self):
+        from deeplearning4j_tpu.ui.i18n import I18N
+
+        i = I18N()
+        assert i.get_message("train.overview.title") == "Training overview"
+        assert i.get_message("train.overview.title", "ja") == "トレーニング概要"
+        # key missing from ja table -> English fallback; unknown key -> key
+        assert i.get_message("tsne.empty", "ja").startswith("No embeddings")
+        assert i.get_message("no.such.key", "de") == "no.such.key"
+        # unknown language -> English
+        assert i.get_message("train.session", "xx") == "Session"
+
+    def test_default_language_switch(self):
+        from deeplearning4j_tpu.ui.i18n import I18N
+
+        i = I18N().set_default_language("de")
+        assert i.get_message("train.overview.title") == "Trainingsübersicht"
+        assert "de" in i.languages() and "ru" in i.languages()
+
+    def test_resource_file_format(self, tmp_path):
+        from deeplearning4j_tpu.ui.i18n import I18N
+
+        p = tmp_path / "custom.it"
+        p.write_text("# comment\ntrain.overview.title=Panoramica\n",
+                     encoding="utf-8")
+        i = I18N().load_directory(str(tmp_path))
+        assert i.get_message("train.overview.title", "it") == "Panoramica"
+        # keys the file lacks fall back to English
+        assert i.get_message("train.session", "it") == "Session"
+
+    def test_rendered_page_localizes(self):
+        from deeplearning4j_tpu.ui.server import UIServer
+        from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+        ui = UIServer()
+        ui.attach(InMemoryStatsStorage())
+        html_ja = ui.render_html(lang="ja")
+        assert "トレーニング概要" in html_ja
+        html_en = ui.render_html()
+        assert "Training overview" in html_en
+
+    def test_served_lang_query(self):
+        import urllib.request
+
+        from deeplearning4j_tpu.ui.server import UIServer
+        from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+        ui = UIServer()
+        ui.attach(InMemoryStatsStorage())
+        ui.serve(port=0)
+        try:
+            base = f"http://127.0.0.1:{ui.port}"
+            body = urllib.request.urlopen(f"{base}/train?lang=zh").read().decode()
+            assert "训练概览" in body
+            body = urllib.request.urlopen(f"{base}/tsne?lang=fr").read().decode()
+            assert "Plongements t-SNE" in body
+        finally:
+            ui.stop()
