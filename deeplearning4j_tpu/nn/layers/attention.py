@@ -124,15 +124,22 @@ class MultiHeadAttention(LayerConfig):
                 head_axis=head_axis, use_flash=ring_flash
             )
         if self.use_flash in ("auto", True):
+            import os as _os
+
             from deeplearning4j_tpu.ops.flash_attention import flash_attention
 
             on_tpu = jax.default_backend() == "tpu"
             if self.use_flash is True or on_tpu:
                 # off-TPU (interpreter) the compiled XLA-remat backward is
                 # far faster than three interpreted Pallas kernels; kmask
-                # loads one [1, block_k] validity row per key block in-kernel
+                # loads one [1, block_k] validity row per key block in-kernel.
+                # Block sizes are env-tunable for perf sweeps (read at trace
+                # time; 128/128 is the measured default).
+                bq = int(_os.environ.get("DL4J_TPU_FLASH_BLOCK_Q", "128"))
+                bk = int(_os.environ.get("DL4J_TPU_FLASH_BLOCK_K", "128"))
                 return flash_attention(q, k, v, kmask=kmask,
                                        causal=self.causal,
+                                       block_q=bq, block_k=bk,
                                        interpret=not on_tpu,
                                        bwd="pallas" if on_tpu else "xla")
         return local_attention(q, k, v, causal=self.causal, kmask=kmask)
@@ -207,6 +214,18 @@ class TransformerBlock(LayerConfig):
         return layer_norm(x, p["gamma"], p["beta"], self.eps)
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        import os as _os
+
+        if _os.environ.get("DL4J_TPU_REMAT_BLOCKS") == "1":
+            # per-block rematerialization: trade recompute for activation
+            # memory (the classic big-transformer policy; perf-sweepable
+            # via tools/exp_transformer_mfu.py remat)
+            body = jax.checkpoint(
+                lambda p, xx, r, m: self._apply_inner(p, xx, train, r, m))
+            return body(params, x, rng, mask), state
+        return self._apply_inner(params, x, train, rng, mask), state
+
+    def _apply_inner(self, params, x, train, rng, mask):
         rng_in, rng_attn = (jax.random.split(rng) if rng is not None else (None, None))
         x = self.maybe_dropout_input(x, train, rng_in)
         h = self._ln(params["ln1"], x)
@@ -214,4 +233,4 @@ class TransformerBlock(LayerConfig):
         x = x + a
         h = self._ln(params["ln2"], x)
         h = self.activation_fn()(h @ params["Wi"] + params["bi"])
-        return x + (h @ params["Wo"] + params["bo"]), state
+        return x + (h @ params["Wo"] + params["bo"])

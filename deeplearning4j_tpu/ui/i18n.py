@@ -155,7 +155,12 @@ class I18N:
     def load_file(self, path: str) -> "I18N":
         """One resource file named ``<anything>.<langcode>`` holding
         ``key=value`` lines ('#'/'!' comments, blank lines ignored)."""
-        lang = os.path.basename(path).rsplit(".", 1)[-1].lower()
+        name = os.path.basename(path)
+        lang = name.rsplit(".", 1)[-1].lower() if "." in name else ""
+        if not (2 <= len(lang) <= 3 and lang.isalpha()):
+            raise ValueError(
+                f"resource file {name!r} needs a language-code extension "
+                "(e.g. messages.en)")
         table = self._messages.setdefault(lang, {})
         with open(path, encoding="utf-8") as f:
             for line in f:

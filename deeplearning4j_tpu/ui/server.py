@@ -313,7 +313,9 @@ class UIServer:
                 self.wfile.write(body)
 
             def do_POST(self):
-                if self.path == "/tsne":
+                from urllib.parse import urlparse
+
+                if urlparse(self.path).path == "/tsne":
                     # TsneModule upload parity: JSON {coords, labels?, name?}
                     try:
                         n = int(self.headers.get("Content-Length", "0"))
@@ -332,7 +334,8 @@ class UIServer:
                     self.end_headers()
                     self.wfile.write(b"ok")
                     return
-                if self.path != "/remote" or outer._remote_storage is None:
+                if urlparse(self.path).path != "/remote" \
+                        or outer._remote_storage is None:
                     self.send_response(404)
                     self.end_headers()
                     return

@@ -224,3 +224,33 @@ class TestI18N:
             assert "Plongements t-SNE" in body
         finally:
             ui.stop()
+
+    def test_load_file_requires_langcode_extension(self, tmp_path):
+        from deeplearning4j_tpu.ui.i18n import I18N
+
+        p = tmp_path / "messages"
+        p.write_text("train.session=X\n")
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="language-code"):
+            I18N().load_file(str(p))
+
+    def test_post_tsne_with_query_string(self):
+        import json as _json
+        import urllib.request
+
+        from deeplearning4j_tpu.ui.server import UIServer
+        from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+        ui = UIServer()
+        ui.attach(InMemoryStatsStorage())
+        ui.serve(port=0)
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{ui.port}/tsne?lang=ja",
+                data=_json.dumps({"coords": [[0, 0], [1, 1]],
+                                  "name": "q"}).encode(),
+                method="POST")
+            assert urllib.request.urlopen(req).status == 200
+            assert "q" in ui._tsne_sets
+        finally:
+            ui.stop()

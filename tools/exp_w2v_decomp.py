@@ -1,0 +1,109 @@
+"""Round-5: decompose the fused SG-NS scan step — where do 12.7 ms/batch go?
+
+Variants (all in the 16-batch scan shape, unroll=4, D=128-padded):
+  full        — gathers + grads + 3 scatters (the real step)
+  no_scatter  — gathers + grads only (params passed through)
+  no_gather   — scatters of precomputed grad rows only
+  scatter1    — only the big syn1 scatter (contexts+negs merged)
+  gather_only — the three gathers, summed
+"""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+V, D, B, K, N_SCAN = 100_000, 128, 65536, 5, 16
+
+
+def gathers_grads(syn0, syn1, c_i, t_i, n_i):
+    c = syn0[c_i]; t = syn1[t_i]; n = syn1[n_i]
+    pos_dot = jnp.sum(c * t, axis=-1)
+    neg_dot = jnp.einsum("bd,bkd->bk", c, n)
+    loss = -jnp.mean(jax.nn.log_sigmoid(pos_dot)
+                     + jnp.sum(jax.nn.log_sigmoid(-neg_dot), axis=-1))
+    gpos = jax.nn.sigmoid(pos_dot) - 1.0
+    gneg = jax.nn.sigmoid(neg_dot)
+    d_c = gpos[:, None] * t + jnp.einsum("bk,bkd->bd", gneg, n)
+    d_t = gpos[:, None] * c
+    d_n = gneg[..., None] * c[:, None, :]
+    return loss, d_c, d_t, d_n
+
+
+def step_full(prm, c_i, t_i, n_i, lr):
+    syn0, syn1 = prm["syn0"], prm["syn1neg"]
+    loss, d_c, d_t, d_n = gathers_grads(syn0, syn1, c_i, t_i, n_i)
+    syn0 = syn0.at[c_i].add(-lr * d_c)
+    syn1 = syn1.at[t_i].add(-lr * d_t)
+    syn1 = syn1.at[n_i.reshape(-1)].add(-lr * d_n.reshape(-1, D))
+    return {"syn0": syn0, "syn1neg": syn1}, loss
+
+
+def step_no_scatter(prm, c_i, t_i, n_i, lr):
+    loss, d_c, d_t, d_n = gathers_grads(prm["syn0"], prm["syn1neg"], c_i, t_i, n_i)
+    # keep grads live via the loss so XLA can't DCE them
+    loss = loss + 1e-12 * (jnp.sum(d_c) + jnp.sum(d_t) + jnp.sum(d_n))
+    return prm, loss
+
+
+def step_no_gather(prm, c_i, t_i, n_i, lr):
+    syn0, syn1 = prm["syn0"], prm["syn1neg"]
+    d = lr * jnp.ones((B, D), jnp.float32)
+    dn = lr * jnp.ones((B * K, D), jnp.float32)
+    syn0 = syn0.at[c_i].add(d)
+    syn1 = syn1.at[t_i].add(d)
+    syn1 = syn1.at[n_i.reshape(-1)].add(dn)
+    return {"syn0": syn0, "syn1neg": syn1}, jnp.float32(0) + syn1[0, 0]
+
+
+def step_scatter1(prm, c_i, t_i, n_i, lr):
+    syn1 = prm["syn1neg"]
+    dn = lr * jnp.ones((B * (K + 1), D), jnp.float32)
+    idx = jnp.concatenate([t_i, n_i.reshape(-1)])
+    syn1 = syn1.at[idx].add(dn)
+    return {"syn0": prm["syn0"], "syn1neg": syn1}, jnp.float32(0) + syn1[0, 0]
+
+
+def step_gather_only(prm, c_i, t_i, n_i, lr):
+    c = prm["syn0"][c_i]; t = prm["syn1neg"][t_i]; n = prm["syn1neg"][n_i]
+    return prm, jnp.sum(c) + jnp.sum(t) + jnp.sum(n)
+
+
+def run(tag, step):
+    rs = np.random.RandomState(0)
+    params = {"syn0": jnp.asarray(rs.rand(V, D).astype(np.float32) * 0.01),
+              "syn1neg": jnp.zeros((V, D), jnp.float32)}
+
+    def draw(shape):
+        z = rs.zipf(1.3, int(np.prod(shape)) * 2)
+        z = z[z <= V][:int(np.prod(shape))] - 1
+        return jnp.asarray(z.reshape(shape).astype(np.int32))
+
+    def scan_fn(prm, c2, t2, n3, lr):
+        def body(p, xs):
+            p, l = step(p, *xs, lr)
+            return p, l
+        return jax.lax.scan(body, prm, (c2, t2, n3), unroll=4)
+
+    jfn = jax.jit(scan_fn, donate_argnums=(0,))
+    c2, t2, n3 = draw((N_SCAN, B)), draw((N_SCAN, B)), draw((N_SCAN, B, K))
+    lr = jnp.asarray(0.0005, jnp.float32)
+    prm = jax.tree.map(lambda x: x + 0, params)
+    for _ in range(2):
+        prm, losses = jfn(prm, c2, t2, n3, lr)
+    float(jnp.sum(losses))
+    t0 = time.perf_counter()
+    for _ in range(4):
+        prm, losses = jfn(prm, c2, t2, n3, lr)
+    float(jnp.sum(losses))
+    dt = (time.perf_counter() - t0) / 4 / N_SCAN
+    print(f"{tag:14s} {dt*1000:7.2f} ms/batch", flush=True)
+
+
+if __name__ == "__main__":
+    import sys
+    print("device:", jax.devices()[0], flush=True)
+    variants = {"full": step_full, "no_scatter": step_no_scatter,
+                "no_gather": step_no_gather, "scatter1": step_scatter1,
+                "gather_only": step_gather_only}
+    for tag in (sys.argv[1:] or list(variants)):
+        run(tag, variants[tag])

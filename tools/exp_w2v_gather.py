@@ -1,0 +1,70 @@
+"""Round-5: is the TPU row gather/scatter byte-bound or row-bound?
+
+Sweep D (row width), dtype, table size, and index order for a fixed row
+count. Each measurement is 20 dispatches with one value-fetch sync; the
+~4 ms dispatch floor is reported alongside so deltas can be read off.
+"""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+N = 393216  # rows gathered/scattered (6*65536)
+V = 100_000
+
+
+def timeit(tag, fn, *args, warmup=3, iters=20):
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    float(jnp.sum(out.astype(jnp.float32)))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    float(jnp.sum(out.astype(jnp.float32)))
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{tag:36s} {dt*1000:8.2f} ms", flush=True)
+    return dt
+
+
+def main():
+    rs = np.random.RandomState(0)
+    print("device:", jax.devices()[0], flush=True)
+    idx_np = rs.randint(0, V, N).astype(np.int32)
+    idx = jnp.asarray(idx_np)
+    idx_sorted = jnp.asarray(np.sort(idx_np))
+    # dispatch floor reference: trivial op
+    x0 = jnp.zeros((8, 128), jnp.float32)
+    timeit("floor: tiny add", jax.jit(lambda a: a + 1.0), x0)
+
+    for D in (8, 32, 128, 512):
+        tab = jnp.asarray(rs.rand(V, D).astype(np.float32))
+        timeit(f"gather f32 D={D}", jax.jit(lambda t, i: t[i]), tab, idx)
+    for D in (128, 512):
+        tab16 = jnp.asarray(rs.rand(V, D).astype(np.float32)).astype(jnp.bfloat16)
+        timeit(f"gather bf16 D={D}", jax.jit(lambda t, i: t[i]), tab16, idx)
+    tab = jnp.asarray(rs.rand(V, 128).astype(np.float32))
+    timeit("gather f32 D=128 sorted idx", jax.jit(lambda t, i: t[i]), tab, idx_sorted)
+    # small table (VMEM-sized)
+    small = jnp.asarray(rs.rand(2048, 128).astype(np.float32))
+    idx_small = jnp.asarray(rs.randint(0, 2048, N).astype(np.int32))
+    timeit("gather f32 D=128 table=2048", jax.jit(lambda t, i: t[i]), small, idx_small)
+
+    dat = jnp.asarray(rs.rand(N, 128).astype(np.float32))
+    timeit("scatter f32 D=128", jax.jit(lambda t, i, d: t.at[i].add(d)), tab, idx, dat)
+    dat16 = dat.astype(jnp.bfloat16)
+    tab16 = tab.astype(jnp.bfloat16)
+    timeit("scatter bf16 D=128", jax.jit(lambda t, i, d: t.at[i].add(d)), tab16, idx, dat16)
+    for D in (8, 32):
+        tabD = jnp.asarray(rs.rand(V, D).astype(np.float32))
+        datD = jnp.asarray(rs.rand(N, D).astype(np.float32))
+        timeit(f"scatter f32 D={D}", jax.jit(lambda t, i, d: t.at[i].add(d)),
+               tabD, idx, datD)
+    # scatter with 80% of rows pointing at one dummy row (drop-mode clamp)
+    idx_dummy = jnp.asarray(np.where(rs.rand(N) < 0.8, V, idx_np).astype(np.int32))
+    timeit("scatter f32 D=128 80%-dropped",
+           jax.jit(lambda t, i, d: t.at[i].add(d, mode="drop")), tab, idx_dummy, dat)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,97 @@
+"""Round-5: D=100 vs D=128-padded W2V step, single dispatch and epoch scan.
+
+Hypothesis from exp_w2v_gather: row gathers at unaligned D=100 take the
+slow path (~8x); padding tables to the 128-lane boundary (zeros in the
+pad lanes are invariant through the SG-NS math) recovers it. Scatter is
+row-bound (~13 ns/row) either way.
+"""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+V, B, K, N_SCAN = 100_000, 65536, 5, 16
+
+
+def make_step(D):
+    def step(params, centers, contexts, negs, lr):
+        syn0, syn1 = params["syn0"], params["syn1neg"]
+        c = syn0[centers]
+        t = syn1[contexts]
+        n = syn1[negs]
+        pos_dot = jnp.sum(c * t, axis=-1)
+        neg_dot = jnp.einsum("bd,bkd->bk", c, n)
+        loss = -jnp.mean(jax.nn.log_sigmoid(pos_dot)
+                         + jnp.sum(jax.nn.log_sigmoid(-neg_dot), axis=-1))
+        gpos = jax.nn.sigmoid(pos_dot) - 1.0
+        gneg = jax.nn.sigmoid(neg_dot)
+        d_c = gpos[:, None] * t + jnp.einsum("bk,bkd->bd", gneg, n)
+        d_t = gpos[:, None] * c
+        d_n = gneg[..., None] * c[:, None, :]
+        syn0 = syn0.at[centers].add(-lr * d_c)
+        syn1 = syn1.at[contexts].add(-lr * d_t)
+        syn1 = syn1.at[negs.reshape(-1)].add(-lr * d_n.reshape(-1, D))
+        return {"syn0": syn0, "syn1neg": syn1}, loss
+    return step
+
+
+def make_scan(step_fn):
+    def scan_fn(params, c2, t2, n3, lr):
+        def body(prm, xs):
+            prm, loss = step_fn(prm, *xs, lr)
+            return prm, loss
+        return jax.lax.scan(body, params, (c2, t2, n3), unroll=4)
+    return scan_fn
+
+
+def bench(tag, D, rs):
+    params = {
+        "syn0": jnp.asarray(np.pad((rs.rand(V, 100).astype(np.float32) - 0.5) / 100,
+                                   ((0, 0), (0, D - 100)))),
+        "syn1neg": jnp.zeros((V, D), jnp.float32),
+    }
+
+    def draw(shape):
+        z = rs.zipf(1.3, int(np.prod(shape)) * 2)
+        z = z[z <= V][:int(np.prod(shape))] - 1
+        return jnp.asarray(z.reshape(shape).astype(np.int32))
+
+    lr = jnp.asarray(0.005, jnp.float32)
+    step = jax.jit(make_step(D), donate_argnums=(0,))
+    c, t, n = draw((B,)), draw((B,)), draw((B, K))
+    prm = jax.tree.map(lambda x: x + 0, params)
+    loss = None
+    for _ in range(3):
+        prm, loss = step(prm, c, t, n, lr)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(20):
+        prm, loss = step(prm, c, t, n, lr)
+    float(loss)
+    dt = (time.perf_counter() - t0) / 20
+    print(f"{tag} single: {dt*1000:7.2f} ms/batch  {B/dt/1e6:6.2f} M pairs/s",
+          flush=True)
+
+    scan = jax.jit(make_scan(make_step(D)), donate_argnums=(0,))
+    c2, t2, n3 = draw((N_SCAN, B)), draw((N_SCAN, B)), draw((N_SCAN, B, K))
+    prm = jax.tree.map(lambda x: x + 0, params)
+    for _ in range(2):
+        prm, losses = scan(prm, c2, t2, n3, lr)
+    float(jnp.sum(losses))
+    t0 = time.perf_counter()
+    for _ in range(4):
+        prm, losses = scan(prm, c2, t2, n3, lr)
+    float(jnp.sum(losses))
+    dt = (time.perf_counter() - t0) / 4
+    print(f"{tag} scan16: {dt/N_SCAN*1000:7.2f} ms/batch  "
+          f"{N_SCAN*B/dt/1e6:6.2f} M pairs/s", flush=True)
+
+
+def main():
+    print("device:", jax.devices()[0], flush=True)
+    bench("D=100  ", 100, np.random.RandomState(0))
+    bench("D=128p ", 128, np.random.RandomState(0))
+
+
+if __name__ == "__main__":
+    main()

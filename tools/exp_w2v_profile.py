@@ -1,0 +1,90 @@
+"""Round-5: component breakdown of the W2V SG-NS step on the real chip.
+
+Which part of the 12.6 ms/batch (B=64K, V=100K, D=100, K=5) is the cost:
+gathers, grad math, sort, segment_sum dense accumulation, scatter-add?
+Each piece measured as its own jitted fn with a value-fetch sync.
+"""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+V, D, B, K = 100_000, 100, 65536, 5
+
+
+def timeit(tag, fn, *args, warmup=3, iters=20):
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    _ = float(jnp.sum(out)) if hasattr(out, "dtype") else float(jnp.sum(out[0]))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _ = float(jnp.sum(out)) if hasattr(out, "dtype") else float(jnp.sum(out[0]))
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{tag:28s} {dt*1000:8.2f} ms", flush=True)
+    return dt
+
+
+def main():
+    rs = np.random.RandomState(0)
+    print("device:", jax.devices()[0], flush=True)
+    syn0 = jnp.asarray(rs.rand(V, D).astype(np.float32))
+    syn1 = jnp.asarray(rs.rand(V, D).astype(np.float32))
+
+    def draw(shape):
+        z = rs.zipf(1.3, int(np.prod(shape)) * 2)
+        z = z[z <= V][:int(np.prod(shape))] - 1
+        return jnp.asarray(z.reshape(shape).astype(np.int32))
+
+    centers = draw((B,))
+    contexts = draw((B,))
+    negs = draw((B, K))
+    allidx = jnp.concatenate([contexts, negs.reshape(-1)])   # [B*(1+K)]
+    dat = jnp.asarray(rs.rand(B * (1 + K), D).astype(np.float32))
+    datB = dat[:B]
+
+    # uniform (non-zipf) indices for comparison
+    uni = jnp.asarray(rs.randint(0, V, B * (1 + K)).astype(np.int32))
+
+    timeit("gather c [B]", jax.jit(lambda i: syn0[i]), centers)
+    timeit("gather n [B,K]", jax.jit(lambda i: syn1[i]), negs)
+    timeit("gather all [6B]", jax.jit(lambda i: syn1[i]), allidx)
+
+    def grads(c_i, t_i, n_i):
+        c = syn0[c_i]; t = syn1[t_i]; n = syn1[n_i]
+        pos = jnp.sum(c * t, -1)
+        neg = jnp.einsum("bd,bkd->bk", c, n)
+        gpos = jax.nn.sigmoid(pos) - 1.0
+        gneg = jax.nn.sigmoid(neg)
+        d_c = gpos[:, None] * t + jnp.einsum("bk,bkd->bd", gneg, n)
+        return d_c
+    timeit("gathers+grad math", jax.jit(grads), centers, contexts, negs)
+
+    timeit("sort [6B]", jax.jit(lambda i: jnp.argsort(i)), allidx)
+    timeit("scatter-add [6B] zipf", jax.jit(lambda i, d: syn1.at[i].add(d)),
+           allidx, dat)
+    timeit("scatter-add [6B] uniform", jax.jit(lambda i, d: syn1.at[i].add(d)),
+           uni, dat)
+    timeit("scatter-add [B] zipf", jax.jit(lambda i, d: syn0.at[i].add(d)),
+           centers, datB)
+    srt = jnp.sort(allidx)
+    timeit("scatter-add [6B] presorted",
+           jax.jit(lambda i, d: syn1.at[i].add(d, indices_are_sorted=True)),
+           srt, dat)
+    timeit("segsum [6B] presorted",
+           jax.jit(lambda i, d: jax.ops.segment_sum(
+               d, i, num_segments=V, indices_are_sorted=True)), srt, dat)
+    timeit("dense add [V,D]", jax.jit(lambda a, b: a + 0.1 * b), syn0, syn1)
+
+    # one-hot matmul accumulation over a HOT subset (zipf head)
+    H = 1024
+    hot = jnp.asarray(np.arange(H, dtype=np.int32))
+    def hot_accum(i, d):
+        oh = jax.nn.one_hot(i, H, dtype=jnp.bfloat16)          # [6B,H] (idx>=H -> 0)
+        return jnp.einsum("bh,bd->hd", oh, d.astype(jnp.bfloat16))
+    timeit("onehot-matmul hot1024 [6B]", jax.jit(hot_accum), allidx, dat)
+
+
+if __name__ == "__main__":
+    main()

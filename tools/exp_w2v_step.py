@@ -1,0 +1,143 @@
+"""Round-5 experiment: Word2Vec SG-NS device-step variants.
+
+The round-4 honest number: fused scatter step ~5M pairs/s, epoch scan
+(unroll=4) ~4x slower than straight-line. VERDICT r4 #1 asks for a
+sort+segment_sum (or dedupe) formulation. This measures, on the real chip:
+
+  scatter        — current _sg_ns_step (.at[].add, unsorted)
+  segsort        — argsort rows + segment_sum(indices_are_sorted=True)
+  segsort_scan   — lax.scan of segsort (unroll=4), epoch-scan shape
+  scatter_scan   — current epoch-scan shape (baseline for the scan path)
+
+Timing discipline: value fetch (float(loss)) is the only sync the axon
+tunnel cannot elide (docs/PERF.md ROUND-4 MEASUREMENT CORRECTION).
+"""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+V, D, B, K, N_SCAN = 100_000, 100, 65536, 5, 16
+
+
+def _loss_and_grads(syn0, syn1, centers, contexts, negs):
+    c = syn0[centers]
+    t = syn1[contexts]
+    n = syn1[negs]
+    pos_dot = jnp.sum(c * t, axis=-1)
+    neg_dot = jnp.einsum("bd,bkd->bk", c, n)
+    loss = -jnp.mean(
+        jax.nn.log_sigmoid(pos_dot) + jnp.sum(jax.nn.log_sigmoid(-neg_dot), axis=-1))
+    gpos = jax.nn.sigmoid(pos_dot) - 1.0
+    gneg = jax.nn.sigmoid(neg_dot)
+    d_c = gpos[:, None] * t + jnp.einsum("bk,bkd->bd", gneg, n)
+    d_t = gpos[:, None] * c
+    d_n = gneg[..., None] * c[:, None, :]
+    return loss, d_c, d_t, d_n
+
+
+def step_scatter(params, centers, contexts, negs, lr):
+    syn0, syn1 = params["syn0"], params["syn1neg"]
+    loss, d_c, d_t, d_n = _loss_and_grads(syn0, syn1, centers, contexts, negs)
+    syn0 = syn0.at[centers].add(-lr * d_c)
+    syn1 = syn1.at[contexts].add(-lr * d_t)
+    syn1 = syn1.at[negs.reshape(-1)].add(-lr * d_n.reshape(-1, D))
+    return {"syn0": syn0, "syn1neg": syn1}, loss
+
+
+def step_segsort(params, centers, contexts, negs, lr):
+    syn0, syn1 = params["syn0"], params["syn1neg"]
+    loss, d_c, d_t, d_n = _loss_and_grads(syn0, syn1, centers, contexts, negs)
+    o0 = jnp.argsort(centers)
+    g0 = jax.ops.segment_sum(d_c[o0], centers[o0], num_segments=V,
+                             indices_are_sorted=True)
+    syn0 = syn0 - lr * g0
+    idx1 = jnp.concatenate([contexts, negs.reshape(-1)])
+    dat1 = jnp.concatenate([d_t, d_n.reshape(-1, D)])
+    o1 = jnp.argsort(idx1)
+    g1 = jax.ops.segment_sum(dat1[o1], idx1[o1], num_segments=V,
+                             indices_are_sorted=True)
+    syn1 = syn1 - lr * g1
+    return {"syn0": syn0, "syn1neg": syn1}, loss
+
+
+def step_segsort_scatter(params, centers, contexts, negs, lr):
+    """Sort, then scatter-add sorted (no dense [V,D] materialisation)."""
+    syn0, syn1 = params["syn0"], params["syn1neg"]
+    loss, d_c, d_t, d_n = _loss_and_grads(syn0, syn1, centers, contexts, negs)
+    o0 = jnp.argsort(centers)
+    syn0 = syn0.at[centers[o0]].add(-lr * d_c[o0], indices_are_sorted=True)
+    idx1 = jnp.concatenate([contexts, negs.reshape(-1)])
+    dat1 = jnp.concatenate([d_t, d_n.reshape(-1, D)])
+    o1 = jnp.argsort(idx1)
+    syn1 = syn1.at[idx1[o1]].add(-lr * dat1[o1], indices_are_sorted=True)
+    return {"syn0": syn0, "syn1neg": syn1}, loss
+
+
+def make_scan(step_fn):
+    def scan_fn(params, centers2d, contexts2d, negs3d, lr):
+        def body(prm, xs):
+            c, t, n = xs
+            prm, loss = step_fn(prm, c, t, n, lr)
+            return prm, loss
+        params, losses = jax.lax.scan(body, params,
+                                      (centers2d, contexts2d, negs3d), unroll=4)
+        return params, losses
+    return scan_fn
+
+
+def timeit(tag, fn, args, n_steps, pairs_per_step, warmup=3, iters=10):
+    # fresh copy: the jitted fn donates its params argument
+    prm = jax.tree.map(lambda x: x + 0, args[0])
+    out = None
+    for _ in range(warmup):
+        out = fn(prm, *args[1:])
+        prm = out[0]
+    float(jnp.sum(out[1]))  # sync
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(prm, *args[1:])
+        prm = out[0]
+    s = float(jnp.sum(out[1]))  # value fetch — the only reliable sync
+    dt = time.perf_counter() - t0
+    pps = iters * n_steps * pairs_per_step / dt
+    print(f"{tag:18s} {dt/iters*1000:8.2f} ms/dispatch  {pps/1e6:8.2f} M pairs/s"
+          f"  (loss {s:.3f})", flush=True)
+    return pps
+
+
+def main():
+    rs = np.random.RandomState(0)
+    dev = jax.devices()[0]
+    print("device:", dev, flush=True)
+    params = {
+        "syn0": jnp.asarray((rs.rand(V, D).astype(np.float32) - 0.5) / D),
+        "syn1neg": jnp.zeros((V, D), jnp.float32),
+    }
+    # zipf-ish indices like a real corpus
+    def draw(shape):
+        z = rs.zipf(1.3, int(np.prod(shape)) * 2)
+        z = z[z <= V][:int(np.prod(shape))] - 1
+        return jnp.asarray(z.reshape(shape).astype(np.int32))
+    centers = draw((B,))
+    contexts = draw((B,))
+    negs = draw((B, K))
+    lr = jnp.asarray(0.025, jnp.float32)
+
+    for tag, fn in [("scatter", step_scatter),
+                    ("segsort", step_segsort),
+                    ("segsort_scatter", step_segsort_scatter)]:
+        jfn = jax.jit(fn, donate_argnums=(0,))
+        timeit(tag, jfn, (params, centers, contexts, negs, lr), 1, B)
+
+    c2 = draw((N_SCAN, B))
+    t2 = draw((N_SCAN, B))
+    n3 = draw((N_SCAN, B, K))
+    for tag, fn in [("scatter_scan", make_scan(step_scatter)),
+                    ("segsort_scan", make_scan(step_segsort))]:
+        jfn = jax.jit(fn, donate_argnums=(0,))
+        timeit(tag, jfn, (params, c2, t2, n3, lr), N_SCAN, B, warmup=2, iters=4)
+
+
+if __name__ == "__main__":
+    main()
