@@ -196,12 +196,12 @@ class LSTM(BaseRecurrent):
 
     def _fused_eligible(self) -> bool:
         """The weight-stationary Pallas scan (ops/fused_lstm.py — the
-        CudnnLSTMHelper analog) covers the standard cell only: default
-        activations and a lane-aligned hidden width."""
+        CudnnLSTMHelper analog) covers the standard and peephole cells
+        with default activations and a lane-aligned hidden width."""
         return (self.activation == "tanh"
                 and self.gate_activation == "sigmoid"
                 and self.n_out % 128 == 0
-                and type(self) is LSTM)
+                and type(self) in (LSTM, GravesLSTM))
 
     def apply_seq(self, params, x, carry, mask=None):
         import os as _os
@@ -217,6 +217,7 @@ class LSTM(BaseRecurrent):
         zx = self._input_proj(params, x)
         h0, c0 = carry
         out, (hT, cT) = fused_lstm(zx, params["Wh"], h0, c0, mask,
+                                   params.get("peephole"),
                                    interpret=not on_tpu)
         return out, (hT, cT)
 

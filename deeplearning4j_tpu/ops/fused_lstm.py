@@ -57,13 +57,19 @@ def _sig(x):
     return jax.nn.sigmoid(x)
 
 
-def _fwd_kernel(zx_ref, wh_ref, h0_ref, c0_ref, m_ref,
-                hs_ref, gates_ref, cs_ref, hT_ref, cT_ref,
-                h_scr, c_scr, *, tc: int, H: int, n_chunks: int):
+def _fwd_kernel(zx_ref, wh_ref, h0_ref, c0_ref, m_ref, *rest,
+                tc: int, H: int, n_chunks: int, has_peep: bool = False):
     """One time-chunk: zx [B, tc, 4H]; Wh [H, 4H] (resident); h0/c0 [B, H];
-    m [B, tc]; outputs hs/cs [B, tc, H] (post-mask carries), gates
-    [B, tc, 4H] (pre-mask, bf16), final carries [B, H]. h/c persist in f32
-    scratch across the sequential chunk grid."""
+    m [B, tc]; optional peephole [1, 3H] (GravesLSTM: c_prev->i,f and
+    c_new->o, LSTMHelpers.java:71); outputs hs/cs [B, tc, H] (post-mask
+    carries), gates [B, tc, 4H] (pre-mask), final carries [B, H]. h/c
+    persist in f32 scratch across the sequential chunk grid."""
+    if has_peep:
+        (peep_ref, hs_ref, gates_ref, cs_ref, hT_ref, cT_ref,
+         h_scr, c_scr) = rest
+    else:
+        (hs_ref, gates_ref, cs_ref, hT_ref, cT_ref, h_scr, c_scr) = rest
+        peep_ref = None
     ci = pl.program_id(0)
 
     @pl.when(ci == 0)
@@ -77,11 +83,19 @@ def _fwd_kernel(zx_ref, wh_ref, h0_ref, c0_ref, m_ref,
         zx_t = zx_ref[:, t, :].astype(jnp.float32)            # [B, 4H]
         z = zx_t + jnp.dot(h.astype(wh_ref.dtype), wh_ref[...],
                            preferred_element_type=jnp.float32)
-        i = _sig(z[:, 0 * H:1 * H])
-        f = _sig(z[:, 1 * H:2 * H])
-        g = jnp.tanh(z[:, 2 * H:3 * H])
-        o = _sig(z[:, 3 * H:4 * H])
-        c_new = f * c + i * g
+        if peep_ref is not None:
+            peep = peep_ref[...].astype(jnp.float32)          # [1, 3H]
+            i = _sig(z[:, 0 * H:1 * H] + c * peep[:, 0 * H:1 * H])
+            f = _sig(z[:, 1 * H:2 * H] + c * peep[:, 1 * H:2 * H])
+            g = jnp.tanh(z[:, 2 * H:3 * H])
+            c_new = f * c + i * g
+            o = _sig(z[:, 3 * H:4 * H] + c_new * peep[:, 2 * H:3 * H])
+        else:
+            i = _sig(z[:, 0 * H:1 * H])
+            f = _sig(z[:, 1 * H:2 * H])
+            g = jnp.tanh(z[:, 2 * H:3 * H])
+            o = _sig(z[:, 3 * H:4 * H])
+            c_new = f * c + i * g
         h_new = o * jnp.tanh(c_new)
         m = m_ref[:, t][:, None].astype(jnp.float32)          # [B, 1]
         h_out = m * h_new + (1.0 - m) * h
@@ -103,13 +117,21 @@ def _fwd_kernel(zx_ref, wh_ref, h0_ref, c0_ref, m_ref,
 
 
 def _bwd_kernel(gates_ref, cs_ref, cprev_ref, hprev_ref, wh_ref, m_ref,
-                dhs_ref, dcT_ref, dzx_ref, dwh_ref, dh0_ref, dc0_ref,
-                dh_scr, dc_scr, dwh_scr, *, tc: int, H: int, n_chunks: int):
+                dhs_ref, dcT_ref, *rest,
+                tc: int, H: int, n_chunks: int, has_peep: bool = False):
     """Reverse-grid chunk: consumes the forward residuals and the output
     cotangent dhs; emits dzx per chunk and (on the last grid step = time
-    chunk 0) dWh / dh0 / dc0. dh/dc/dWh persist in f32 scratch; the
-    final-carry cotangents seed them (dhT is folded into dhs[T-1] by the
-    caller — h_T IS hs[:, T-1] — and dcT seeds the dc scratch here)."""
+    chunk 0) dWh / dh0 / dc0 (+ dpeephole). dh/dc/dWh (+dpeep) persist in
+    f32 scratch; the final-carry cotangents seed them (dhT is folded into
+    dhs[T-1] by the caller — h_T IS hs[:, T-1] — and dcT seeds the dc
+    scratch here)."""
+    if has_peep:
+        (peep_ref, dzx_ref, dwh_ref, dh0_ref, dc0_ref, dpeep_ref,
+         dh_scr, dc_scr, dwh_scr, dpeep_scr) = rest
+    else:
+        (dzx_ref, dwh_ref, dh0_ref, dc0_ref,
+         dh_scr, dc_scr, dwh_scr) = rest
+        peep_ref = dpeep_ref = dpeep_scr = None
     ci = pl.program_id(0)
 
     @pl.when(ci == 0)
@@ -117,6 +139,8 @@ def _bwd_kernel(gates_ref, cs_ref, cprev_ref, hprev_ref, wh_ref, m_ref,
         dh_scr[...] = jnp.zeros_like(dh_scr)
         dc_scr[...] = dcT_ref[...].astype(jnp.float32)
         dwh_scr[...] = jnp.zeros_like(dwh_scr)
+        if dpeep_scr is not None:
+            dpeep_scr[...] = jnp.zeros_like(dpeep_scr)
 
     def step(k, _):
         t = tc - 1 - k
@@ -137,8 +161,12 @@ def _bwd_kernel(gates_ref, cs_ref, cprev_ref, hprev_ref, wh_ref, m_ref,
 
         tanh_c = jnp.tanh(c_t)
         dh_g = A * m                       # gate-path share
-        do = dh_g * tanh_c * o * (1.0 - o)
+        do = dh_g * tanh_c * o * (1.0 - o)          # dz_o (a-level)
         dcg = C * m + dh_g * o * (1.0 - tanh_c * tanh_c)
+        if peep_ref is not None:
+            peep = peep_ref[...].astype(jnp.float32)          # [1, 3H]
+            # o = sig(z_o + c_new * p_o): its c_new dependence feeds dcg
+            dcg = dcg + do * peep[:, 2 * H:3 * H]
         di = dcg * g * i * (1.0 - i)
         dg = dcg * i * (1.0 - g * g)
         df = dcg * c_prev * f * (1.0 - f)
@@ -149,11 +177,21 @@ def _bwd_kernel(gates_ref, cs_ref, cprev_ref, hprev_ref, wh_ref, m_ref,
         dwh_scr[...] += jnp.dot(h_prev.astype(wh_ref.dtype).T,
                                 dz.astype(wh_ref.dtype),
                                 preferred_element_type=jnp.float32)
-        dh_scr[...] = jnp.dot(dz.astype(wh_ref.dtype),
-                              wh_ref[...].T,
-                              preferred_element_type=jnp.float32) \
-            + A * (1.0 - m)
-        dc_scr[...] = dcg * f + C * (1.0 - m)
+        dh_new = jnp.dot(dz.astype(wh_ref.dtype), wh_ref[...].T,
+                         preferred_element_type=jnp.float32) + A * (1.0 - m)
+        dc_new = dcg * f + C * (1.0 - m)
+        if peep_ref is not None:
+            # i/f peepholes read c_prev: route their a-level cotangents
+            # into dc_{t-1}; accumulate the [3H] peephole grads
+            dc_new = dc_new + di * peep[:, 0 * H:1 * H] \
+                + df * peep[:, 1 * H:2 * H]
+            dpeep_scr[...] += jnp.concatenate([
+                jnp.sum(di * c_prev, axis=0, keepdims=True),
+                jnp.sum(df * c_prev, axis=0, keepdims=True),
+                jnp.sum(do * c_t, axis=0, keepdims=True),
+            ], axis=-1)                                       # [1, 3H]
+        dh_scr[...] = dh_new
+        dc_scr[...] = dc_new
         return 0
 
     lax.fori_loop(0, tc, step, 0, unroll=True)
@@ -163,6 +201,8 @@ def _bwd_kernel(gates_ref, cs_ref, cprev_ref, hprev_ref, wh_ref, m_ref,
         dwh_ref[...] = dwh_scr[...].astype(dwh_ref.dtype)
         dh0_ref[...] = dh_scr[...].astype(dh0_ref.dtype)
         dc0_ref[...] = dc_scr[...].astype(dc0_ref.dtype)
+        if dpeep_ref is not None:
+            dpeep_ref[...] = dpeep_scr[...].astype(dpeep_ref.dtype)
 
 
 def _pick_chunk(T: int, B: int, H: int, itemsize: int) -> int:
@@ -191,13 +231,13 @@ def _pad_time(x, T_pad):
     return jnp.pad(x, cfg)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
-def _fused(zx, wh, h0, c0, mask, interpret):
-    out, _res = _fused_fwd(zx, wh, h0, c0, mask, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
+def _fused(zx, wh, h0, c0, mask, peephole, interpret):
+    out, _res = _fused_fwd(zx, wh, h0, c0, mask, peephole, interpret)
     return out
 
 
-def _fwd_call(zx, wh, h0, c0, mask, interpret, tc):
+def _fwd_call(zx, wh, h0, c0, mask, peephole, interpret, tc):
     B, T, Z = zx.shape
     H = Z // 4
     n_chunks = T // tc
@@ -206,17 +246,23 @@ def _fwd_call(zx, wh, h0, c0, mask, interpret, tc):
         kw["memory_space"] = _VMEM
     blk_t = lambda ci: (0, ci, 0)        # noqa: E731
     pin = lambda ci: (0, 0)              # noqa: E731
-    kernel = functools.partial(_fwd_kernel, tc=tc, H=H, n_chunks=n_chunks)
+    kernel = functools.partial(_fwd_kernel, tc=tc, H=H, n_chunks=n_chunks,
+                               has_peep=peephole is not None)
+    in_specs = [
+        pl.BlockSpec((B, tc, Z), blk_t, **kw),
+        pl.BlockSpec((H, Z), pin, **kw),
+        pl.BlockSpec((B, H), pin, **kw),
+        pl.BlockSpec((B, H), pin, **kw),
+        pl.BlockSpec((B, tc), lambda ci: (0, ci), **kw),
+    ]
+    args = [zx, wh, h0, c0, mask]
+    if peephole is not None:
+        in_specs.append(pl.BlockSpec((1, 3 * H), pin, **kw))
+        args.append(peephole.reshape(1, 3 * H))
     return pl.pallas_call(
         kernel,
         grid=(n_chunks,),
-        in_specs=[
-            pl.BlockSpec((B, tc, Z), blk_t, **kw),
-            pl.BlockSpec((H, Z), pin, **kw),
-            pl.BlockSpec((B, H), pin, **kw),
-            pl.BlockSpec((B, H), pin, **kw),
-            pl.BlockSpec((B, tc), lambda ci: (0, ci), **kw),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((B, tc, H), blk_t, **kw),
             pl.BlockSpec((B, tc, Z), blk_t, **kw),
@@ -238,10 +284,10 @@ def _fwd_call(zx, wh, h0, c0, mask, interpret, tc):
             pltpu.VMEM((B, H), jnp.float32),
         ] if pltpu is not None else [],
         interpret=interpret,
-    )(zx, wh, h0, c0, mask)
+    )(*args)
 
 
-def _fused_fwd(zx, wh, h0, c0, mask, interpret):
+def _fused_fwd(zx, wh, h0, c0, mask, peephole, interpret):
     B, T, Z = zx.shape
     H = Z // 4
     tc = _pick_chunk(T, B, H, jnp.dtype(zx.dtype).itemsize)
@@ -249,17 +295,19 @@ def _fused_fwd(zx, wh, h0, c0, mask, interpret):
     zx_p = _pad_time(zx, T_pad)
     m = jnp.ones((B, T), zx.dtype) if mask is None else mask.astype(zx.dtype)
     m_p = _pad_time(m, T_pad)          # padded steps: mask 0 = carry freeze
-    hs, gates, cs, hT, cT = _fwd_call(zx_p, wh, h0, c0, m_p, interpret, tc)
+    hs, gates, cs, hT, cT = _fwd_call(zx_p, wh, h0, c0, m_p, peephole,
+                                      interpret, tc)
     hs = hs[:, :T]
     out = hs * m[..., None] if mask is not None else hs
     # zx itself is NOT a backward residual: the gates carry everything the
     # reverse sweep needs (keeping zx alive would hold an extra [B,T,4H]
     # HBM buffer across the step for nothing)
     return ((out, (hT, cT)),
-            (gates[:, :T], wh, h0, c0, mask, hs, cs[:, :T]))
+            (gates[:, :T], wh, h0, c0, mask, peephole, hs, cs[:, :T]))
 
 
-def _bwd_call(gates, cs, cprev, hprev, wh, m, dhs, dcT, interpret, tc):
+def _bwd_call(gates, cs, cprev, hprev, wh, m, dhs, dcT, peephole,
+              interpret, tc):
     B, T, Z = gates.shape
     H = Z // 4
     n_chunks = T // tc
@@ -269,44 +317,58 @@ def _bwd_call(gates, cs, cprev, hprev, wh, m, dhs, dcT, interpret, tc):
     rev_t = lambda ci: (0, n_chunks - 1 - ci, 0)   # noqa: E731
     rev_m = lambda ci: (0, n_chunks - 1 - ci)      # noqa: E731
     pin = lambda ci: (0, 0)                        # noqa: E731
-    kernel = functools.partial(_bwd_kernel, tc=tc, H=H, n_chunks=n_chunks)
+    has_peep = peephole is not None
+    kernel = functools.partial(_bwd_kernel, tc=tc, H=H, n_chunks=n_chunks,
+                               has_peep=has_peep)
+    in_specs = [
+        pl.BlockSpec((B, tc, Z), rev_t, **kw),
+        pl.BlockSpec((B, tc, H), rev_t, **kw),
+        pl.BlockSpec((B, tc, H), rev_t, **kw),
+        pl.BlockSpec((B, tc, H), rev_t, **kw),
+        pl.BlockSpec((H, Z), pin, **kw),
+        pl.BlockSpec((B, tc), rev_m, **kw),
+        pl.BlockSpec((B, tc, H), rev_t, **kw),
+        pl.BlockSpec((B, H), pin, **kw),
+    ]
+    args = [gates, cs, cprev, hprev, wh, m, dhs, dcT]
+    out_specs = [
+        pl.BlockSpec((B, tc, Z), rev_t, **kw),
+        pl.BlockSpec((H, Z), pin, **kw),
+        pl.BlockSpec((B, H), pin, **kw),
+        pl.BlockSpec((B, H), pin, **kw),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((B, T, Z), jnp.float32),    # dzx
+        jax.ShapeDtypeStruct((H, Z), jnp.float32),       # dWh
+        jax.ShapeDtypeStruct((B, H), jnp.float32),       # dh0
+        jax.ShapeDtypeStruct((B, H), jnp.float32),       # dc0
+    ]
+    scratch = [
+        pltpu.VMEM((B, H), jnp.float32),
+        pltpu.VMEM((B, H), jnp.float32),
+        pltpu.VMEM((H, Z), jnp.float32),
+    ] if pltpu is not None else []
+    if has_peep:
+        in_specs.append(pl.BlockSpec((1, 3 * H), pin, **kw))
+        args.append(peephole.reshape(1, 3 * H))
+        out_specs.append(pl.BlockSpec((1, 3 * H), pin, **kw))
+        out_shape.append(jax.ShapeDtypeStruct((1, 3 * H), jnp.float32))
+        if pltpu is not None:
+            scratch.append(pltpu.VMEM((1, 3 * H), jnp.float32))
     return pl.pallas_call(
         kernel,
         grid=(n_chunks,),
-        in_specs=[
-            pl.BlockSpec((B, tc, Z), rev_t, **kw),
-            pl.BlockSpec((B, tc, H), rev_t, **kw),
-            pl.BlockSpec((B, tc, H), rev_t, **kw),
-            pl.BlockSpec((B, tc, H), rev_t, **kw),
-            pl.BlockSpec((H, Z), pin, **kw),
-            pl.BlockSpec((B, tc), rev_m, **kw),
-            pl.BlockSpec((B, tc, H), rev_t, **kw),
-            pl.BlockSpec((B, H), pin, **kw),
-        ],
-        out_specs=[
-            pl.BlockSpec((B, tc, Z), rev_t, **kw),
-            pl.BlockSpec((H, Z), pin, **kw),
-            pl.BlockSpec((B, H), pin, **kw),
-            pl.BlockSpec((B, H), pin, **kw),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B, T, Z), jnp.float32),    # dzx
-            jax.ShapeDtypeStruct((H, Z), jnp.float32),       # dWh
-            jax.ShapeDtypeStruct((B, H), jnp.float32),       # dh0
-            jax.ShapeDtypeStruct((B, H), jnp.float32),       # dc0
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((B, H), jnp.float32),
-            pltpu.VMEM((B, H), jnp.float32),
-            pltpu.VMEM((H, Z), jnp.float32),
-        ] if pltpu is not None else [],
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
         interpret=interpret,
-    )(gates, cs, cprev, hprev, wh, m, dhs, dcT)
+    )(*args)
 
 
 def _fused_bwd(interpret, res, cts):
     (dout, (dhT, dcT)) = cts
-    gates, wh, h0, c0, mask, hs, cs = res
+    gates, wh, h0, c0, mask, peephole, hs, cs = res
     zx_dtype = hs.dtype              # hs was emitted in zx's dtype
     B, T, Z = gates.shape
     H = Z // 4
@@ -329,24 +391,33 @@ def _fused_bwd(interpret, res, cts):
     # row (the kernel adds dhs[t] to the carry WITHOUT the mask factor);
     # dcT seeds the kernel's dc scratch at the first reverse chunk.
     dhs = dhs.at[:, T - 1].add(dhT.astype(jnp.float32))
-    dzx_p, dwh, dh0, dc0 = _bwd_call(
+    outs = _bwd_call(
         pad(gates), pad(cs), pad(cprev), pad(hprev), wh,
-        pad(m), pad(dhs), dcT.astype(jnp.float32), interpret, tc)
+        pad(m), pad(dhs), dcT.astype(jnp.float32), peephole, interpret, tc)
+    if peephole is not None:
+        dzx_p, dwh, dh0, dc0, dpeep = outs
+        dpeep = dpeep.reshape(3 * H).astype(peephole.dtype)
+    else:
+        dzx_p, dwh, dh0, dc0 = outs
+        dpeep = None
     dzx = dzx_p[:, :T]
     return dzx.astype(zx_dtype), dwh.astype(wh.dtype), \
         dh0.astype(h0.dtype), dc0.astype(c0.dtype), \
-        (jnp.zeros_like(mask) if mask is not None else None)
+        (jnp.zeros_like(mask) if mask is not None else None), dpeep
 
 
 _fused.defvjp(_fused_fwd, _fused_bwd)
 
 
-def fused_lstm(zx, wh, h0, c0, mask=None, *, interpret: bool = False):
+def fused_lstm(zx, wh, h0, c0, mask=None, peephole=None, *,
+               interpret: bool = False):
     """Weight-stationary LSTM recurrence over precomputed input rows.
 
     zx: [B, T, 4H] (= x @ Wx + b, gate order [i, f, g, o]);
     wh: [H, 4H]; h0/c0: [B, H]; mask: optional [B, T] (masked steps carry
-    state through and output zeros — the framework's recurrent contract).
+    state through and output zeros — the framework's recurrent contract);
+    peephole: optional [3H] = [p_i | p_f | p_o] (GravesLSTM: c_prev feeds
+    i and f, c_new feeds o — LSTMHelpers.java:71).
     Returns (outputs [B, T, H], (h_T, c_T)). Differentiable (custom VJP,
     blockwise Pallas backward); BOTH final-carry cotangents are exact —
     dhT folds into the last timestep's output row, dcT seeds the reverse
@@ -354,4 +425,4 @@ def fused_lstm(zx, wh, h0, c0, mask=None, *, interpret: bool = False):
     """
     if mask is not None:
         mask = jnp.asarray(mask, jnp.float32)
-    return _fused(zx, wh, h0, c0, mask, interpret)
+    return _fused(zx, wh, h0, c0, mask, peephole, interpret)

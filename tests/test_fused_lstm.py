@@ -16,20 +16,29 @@ import jax.numpy as jnp
 from deeplearning4j_tpu.ops.fused_lstm import fused_lstm
 
 
-def _oracle(zx, wh, h0, c0, mask=None):
-    """The exact math of nn/layers/recurrent.py LSTM._cell_from_proj +
-    apply_seq's mask contract, written independently as a lax.scan."""
+def _oracle(zx, wh, h0, c0, mask=None, peep=None):
+    """The exact math of nn/layers/recurrent.py LSTM/GravesLSTM
+    _cell_from_proj + apply_seq's mask contract, written independently as
+    a lax.scan. ``peep`` [3H] adds the GravesLSTM peephole terms
+    (c_prev -> i/f, c_new -> o)."""
     H = wh.shape[0]
 
     def step(carry, inp):
         h, c = carry
         zx_t, m_t = inp
         z = zx_t + h @ wh
-        i = jax.nn.sigmoid(z[:, :H])
-        f = jax.nn.sigmoid(z[:, H:2 * H])
-        g = jnp.tanh(z[:, 2 * H:3 * H])
-        o = jax.nn.sigmoid(z[:, 3 * H:])
-        c_new = f * c + i * g
+        if peep is not None:
+            i = jax.nn.sigmoid(z[:, :H] + c * peep[:H])
+            f = jax.nn.sigmoid(z[:, H:2 * H] + c * peep[H:2 * H])
+            g = jnp.tanh(z[:, 2 * H:3 * H])
+            c_new = f * c + i * g
+            o = jax.nn.sigmoid(z[:, 3 * H:] + c_new * peep[2 * H:])
+        else:
+            i = jax.nn.sigmoid(z[:, :H])
+            f = jax.nn.sigmoid(z[:, H:2 * H])
+            g = jnp.tanh(z[:, 2 * H:3 * H])
+            o = jax.nn.sigmoid(z[:, 3 * H:])
+            c_new = f * c + i * g
         h_new = o * jnp.tanh(c_new)
         if m_t is not None:
             mm = m_t[:, None]
@@ -179,5 +188,147 @@ class TestLayerPolicy:
 
         assert not LSTM(n_out=100)._fused_eligible()          # lane-unaligned
         assert not LSTM(n_out=128, activation="relu")._fused_eligible()
-        assert not GravesLSTM(n_out=128)._fused_eligible()    # peepholes
+        assert GravesLSTM(n_out=128)._fused_eligible()        # peepholes OK (r5)
         assert LSTM(n_out=256)._fused_eligible()
+
+
+def _graves_oracle(zx, wh, peep, h0, c0, mask=None):
+    """Peephole oracle == the shared _oracle with peep terms enabled."""
+    return _oracle(zx, wh, h0, c0, mask, peep)
+
+
+class TestPeephole:
+    """GravesLSTM peepholes in the fused kernel (the bench's BASELINE
+    char-RNN model is GravesLSTM — CudnnLSTMHelper covers it too)."""
+
+    def test_forward_matches_graves_oracle(self):
+        rs = np.random.RandomState(6)
+        B, T, H = 2, 6, 128
+        zx, wh = _rand(rs, B, T, 4 * H), _rand(rs, H, 4 * H)
+        peep = _rand(rs, 3 * H)
+        h0, c0 = _rand(rs, B, H), _rand(rs, B, H)
+        out, (hT, cT) = fused_lstm(zx, wh, h0, c0, peephole=peep,
+                                   interpret=True)
+        ref, (hr, cr) = _graves_oracle(zx, wh, peep, h0, c0)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(cT), np.asarray(cr),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_grads_match_graves_oracle(self):
+        rs = np.random.RandomState(7)
+        B, T, H = 2, 5, 128
+        zx, wh = _rand(rs, B, T, 4 * H), _rand(rs, H, 4 * H)
+        peep = _rand(rs, 3 * H)
+        h0, c0 = _rand(rs, B, H), _rand(rs, B, H)
+
+        def loss_f(zx, wh, peep, h0, c0):
+            out, (hT, cT) = fused_lstm(zx, wh, h0, c0, peephole=peep,
+                                       interpret=True)
+            return jnp.sum(out ** 2) + jnp.sum(hT * 0.5) + jnp.sum(cT * 0.25)
+
+        def loss_o(zx, wh, peep, h0, c0):
+            out, (hT, cT) = _graves_oracle(zx, wh, peep, h0, c0)
+            return jnp.sum(out ** 2) + jnp.sum(hT * 0.5) + jnp.sum(cT * 0.25)
+
+        gf = jax.grad(loss_f, argnums=(0, 1, 2, 3, 4))(zx, wh, peep, h0, c0)
+        go = jax.grad(loss_o, argnums=(0, 1, 2, 3, 4))(zx, wh, peep, h0, c0)
+        for a, b, name in zip(gf, go, ("dzx", "dWh", "dpeep", "dh0", "dc0")):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=6e-4, atol=6e-4, err_msg=name)
+
+    def test_masked_peephole_grads(self):
+        rs = np.random.RandomState(8)
+        B, T, H = 2, 4, 128
+        zx, wh = _rand(rs, B, T, 4 * H), _rand(rs, H, 4 * H)
+        peep = _rand(rs, 3 * H)
+        h0, c0 = _rand(rs, B, H), _rand(rs, B, H)
+        m = jnp.asarray(np.array([[1, 1, 0, 0], [1, 1, 1, 1]], np.float32))
+
+        def mk(fn):
+            def loss(zx, wh, peep):
+                out, (hT, cT) = fn(zx, wh, peep)
+                return jnp.sum(out ** 2) + jnp.sum(hT) + jnp.sum(cT * 0.5)
+            return loss
+
+        gf = jax.grad(mk(lambda zx, wh, p: fused_lstm(
+            zx, wh, h0, c0, m, p, interpret=True)), argnums=(0, 1, 2))(zx, wh, peep)
+        go = jax.grad(mk(lambda zx, wh, p: _graves_oracle(
+            zx, wh, p, h0, c0, m)), argnums=(0, 1, 2))(zx, wh, peep)
+        for a, b, name in zip(gf, go, ("dzx", "dWh", "dpeep")):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=6e-4, atol=6e-4, err_msg=name)
+
+    def test_graves_layer_forced_fused_matches_scan(self):
+        import os
+
+        from deeplearning4j_tpu.nn.input_type import InputType
+        from deeplearning4j_tpu.nn.layers.recurrent import GravesLSTM
+
+        rs = np.random.RandomState(9)
+        layer = GravesLSTM(n_out=128)
+        params = layer.init(jax.random.PRNGKey(1), InputType.recurrent(12, 5))
+        params = {**params,
+                  "peephole": _rand(rs, 3 * 128) * 0.2}  # nonzero peepholes
+        x = jnp.asarray(rs.randn(2, 5, 12).astype(np.float32))
+        old = os.environ.get("DL4J_TPU_FUSED_LSTM")
+        try:
+            os.environ["DL4J_TPU_FUSED_LSTM"] = "0"
+            y_scan, _ = layer.apply(params, {}, x)
+            os.environ["DL4J_TPU_FUSED_LSTM"] = "1"
+            y_fused, _ = layer.apply(params, {}, x)
+        finally:
+            if old is None:
+                os.environ.pop("DL4J_TPU_FUSED_LSTM", None)
+            else:
+                os.environ["DL4J_TPU_FUSED_LSTM"] = old
+        np.testing.assert_allclose(np.asarray(y_fused), np.asarray(y_scan),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_multichunk_and_padded_peephole(self, monkeypatch):
+        """Force tc=2: T=6 -> 3 chunks (cross-chunk dpeep accumulation)
+        and T=5 -> padded tail (mask-0 rows through the peephole path)."""
+        import deeplearning4j_tpu.ops.fused_lstm as F
+
+        monkeypatch.setattr(F, "_pick_chunk", lambda *a: 2)
+        rs = np.random.RandomState(10)
+        for T in (6, 5):
+            B, H = 2, 128
+            zx, wh = _rand(rs, B, T, 4 * H), _rand(rs, H, 4 * H)
+            peep = _rand(rs, 3 * H)
+            h0, c0 = _rand(rs, B, H), _rand(rs, B, H)
+
+            def loss(fn):
+                def go(zx, wh, p):
+                    out, (hT, cT) = fn(zx, wh, p)
+                    return jnp.sum(out ** 2) + jnp.sum(hT) + jnp.sum(cT * 0.5)
+                return go
+
+            gf = jax.grad(loss(lambda z, w, p: F.fused_lstm(
+                z, w, h0, c0, peephole=p, interpret=True)),
+                argnums=(0, 1, 2))(zx, wh, peep)
+            go_ = jax.grad(loss(lambda z, w, p: _graves_oracle(
+                z, w, p, h0, c0)), argnums=(0, 1, 2))(zx, wh, peep)
+            for a, b, name in zip(gf, go_, ("dzx", "dWh", "dpeep")):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=6e-4, atol=6e-4,
+                    err_msg=f"T={T} {name}")
+
+    def test_bf16_peephole_finite_and_close(self):
+        rs = np.random.RandomState(11)
+        B, T, H = 2, 4, 128
+        zx = _rand(rs, B, T, 4 * H).astype(jnp.bfloat16)
+        wh = _rand(rs, H, 4 * H).astype(jnp.bfloat16)
+        peep = (_rand(rs, 3 * H) * 0.2).astype(jnp.bfloat16)
+        h0 = jnp.zeros((B, H), jnp.bfloat16)
+        c0 = jnp.zeros((B, H), jnp.bfloat16)
+        out, _ = fused_lstm(zx, wh, h0, c0, peephole=peep, interpret=True)
+        ref, _ = _graves_oracle(zx.astype(jnp.float32), wh.astype(jnp.float32),
+                                peep.astype(jnp.float32),
+                                h0.astype(jnp.float32), c0.astype(jnp.float32))
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref), rtol=5e-2, atol=5e-2)
+        g = jax.grad(lambda p: jnp.sum(fused_lstm(
+            zx, wh, h0, c0, peephole=p,
+            interpret=True)[0].astype(jnp.float32) ** 2))(peep)
+        assert np.all(np.isfinite(np.asarray(g, np.float32)))
