@@ -1,0 +1,30 @@
+#!/bin/bash
+# Round-5 chip-session runbook: run when the axon grant returns.
+# ONE python process at a time (single-process grant); results append to
+# /tmp/chip_session.log. Order = VERDICT priority.
+set -u
+LOG=/tmp/chip_session.log
+run() {
+  echo "=== $* $(date +%H:%M:%S)" >> "$LOG"
+  "$@" >> "$LOG" 2>&1
+  echo "--- exit $? $(date +%H:%M:%S)" >> "$LOG"
+}
+cd /root/repo
+export PYTHONPATH=/root/.axon_site:/root/repo
+
+# 1. W2V: where do the 12.6 ms/batch go? (then decide the lever)
+run python tools/exp_w2v_decomp.py full no_scatter
+run python tools/exp_w2v_decomp.py no_gather gather_only
+
+# 2. fused LSTM A/B on the real char-RNN bench config
+run python tools/exp_lstm_fused.py scan
+run python tools/exp_lstm_fused.py fused
+
+# 3. transformer MFU: default blocks, then the two most promising combos
+run python tools/exp_transformer_mfu.py sweep 0   # 128/128 baseline
+run python tools/exp_transformer_mfu.py sweep 3   # 256/128
+run python tools/exp_transformer_mfu.py sweep 5   # 256/256
+run python tools/exp_transformer_mfu.py remat
+run python tools/exp_transformer_mfu.py opmix
+
+echo "CHIP SESSION DONE $(date)" >> "$LOG"
