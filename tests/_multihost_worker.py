@@ -1,12 +1,15 @@
 """Subprocess worker for tests/test_multihost.py: one training process in a
 2-process CPU cluster (4 virtual devices each -> 8-device global mesh).
 
-Three scenarios per run (the round-4 hardening of SURVEY §2.5 coverage):
+Four scenarios per run (round-4 hardening + round-5 of SURVEY §2.5):
   1. dense MLP, even per-host batches      (the original mechanism proof)
   2. conv+BN net, UNEVEN per-host batches  (host0: 10 rows, host1: 6) —
      exactness relies on the allgather-equalized padding + global loss
      rescale in ParallelWrapper and ex_weight-excluded BN statistics
+     (+2b: the same through a ComputationGraph)
   3. multi-host x tensor-parallel smoke    (data=4 x model=2 mesh)
+  4. CROSS-HOST ring attention             (data=1 x seq=8: every ring
+     ppermute crosses the host boundary; losses must equal a local run)
 """
 
 import json
@@ -19,6 +22,13 @@ def main():
     nproc = int(sys.argv[2])
     port = sys.argv[3]
     outdir = sys.argv[4]
+    # persistent compile cache: five scenario compiles per worker would
+    # otherwise start cold every run and flirt with the test's 420s
+    # subprocess timeout on slow machines
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(outdir, os.pardir, "mh_xla_cache"))
+    os.makedirs(os.environ["JAX_COMPILATION_CACHE_DIR"], exist_ok=True)
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from __graft_entry__ import _provision_cpu_mesh
@@ -147,6 +157,25 @@ def main():
     l2 = float(tr.fit_batch(xg3, yg3))
     assert np.isfinite(l1) and np.isfinite(l2), (l1, l2)
     results["tp_losses"] = [l1, l2]
+
+    # ---- scenario 4: CROSS-HOST ring attention (sequence parallel) ------
+    # seq=8 spans both processes, so every ring step's ppermute crosses
+    # the host boundary — the DCN analog of the reference's multi-node
+    # gradient/activation transport, exercised through the attention core
+    # (round 5; parallel/ring.py).
+    mesh_sp = make_mesh(MeshSpec(data=1, model=1, seq=8))
+    conf_sp = TransformerLM(vocab_size=32, max_len=32, d_model=32, n_heads=2,
+                            n_blocks=1, sequence_parallel=True,
+                            dtype="float32", seed=21)
+    model4 = MultiLayerNetwork(conf_sp).init()
+    tr4 = ShardedTrainer(model4, mesh_sp)
+    rs4 = np.random.RandomState(9)
+    x4 = rs4.randint(0, 32, (2, 32))
+    y4 = np.eye(32, dtype=np.float32)[rs4.randint(0, 32, (2, 32))]
+    s1 = float(tr4.fit_batch(x4, y4))
+    s2 = float(tr4.fit_batch(x4, y4))
+    assert np.isfinite(s1) and np.isfinite(s2), (s1, s2)
+    results["sp_losses"] = [s1, s2]
 
     if idx == 0:
         results["processes"] = nproc

@@ -152,3 +152,21 @@ def test_two_process_training_matches_single_process(tmp_path):
         done = json.load(f)
     assert done["processes"] == 2 and done["devices"] == 8
     assert all(np.isfinite(v) for v in done["tp_losses"])
+
+    # ---- scenario 4: CROSS-HOST ring attention == single-process run ----
+    # (seq=8 spans both workers: every ring ppermute crossed the host
+    # boundary; the losses must match a local data=1 x seq=8 run exactly)
+    from deeplearning4j_tpu.models import TransformerLM
+    from deeplearning4j_tpu.parallel import ShardedTrainer
+
+    conf_sp = TransformerLM(vocab_size=32, max_len=32, d_model=32, n_heads=2,
+                            n_blocks=1, sequence_parallel=True,
+                            dtype="float32", seed=21)
+    model4 = MultiLayerNetwork(conf_sp).init()
+    tr4 = ShardedTrainer(model4, make_mesh(MeshSpec(data=1, model=1, seq=8)))
+    rs4 = np.random.RandomState(9)
+    x4 = rs4.randint(0, 32, (2, 32))
+    y4 = np.eye(32, dtype=np.float32)[rs4.randint(0, 32, (2, 32))]
+    ref_sp = [float(tr4.fit_batch(x4, y4)), float(tr4.fit_batch(x4, y4))]
+    np.testing.assert_allclose(done["sp_losses"], ref_sp, rtol=1e-5,
+                               err_msg="cross-host ring attention diverged")
