@@ -821,6 +821,10 @@ class ComputationGraph:
 
     # -- jitted step -------------------------------------------------------
     def _make_step(self, with_carries: bool = False):
+        return jax.jit(self._make_step_body(with_carries),
+                       donate_argnums=(0, 1, 2))
+
+    def _make_step_body(self, with_carries: bool = False):
         order = self.topo_order
         updaters = self._updaters
 
@@ -860,7 +864,7 @@ class ComputationGraph:
                 new_opt[name] = ns
             return new_params, new_opt, new_state, new_carries, loss
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return step
 
     def _get_step_fn(self, with_carries: bool):
         if with_carries:
@@ -870,6 +874,41 @@ class ComputationGraph:
         if self._step_fn is None:
             self._step_fn = self._make_step(False)
         return self._step_fn
+
+    # -- chained steps (K per dispatch; mirrors MultiLayerNetwork) ---------
+    def _chain_k(self) -> int:
+        """Steps chained per dispatch in fit()'s hot loop (0 = per-step);
+        policy shared with MultiLayerNetwork (_chain_k_from_env)."""
+        from deeplearning4j_tpu.nn.model import _chain_k_from_env
+
+        uses_rng = any(self.rt[n].config.uses_rng() for n in self.topo_order
+                       if hasattr(self.rt[n].config, "uses_rng"))
+        return _chain_k_from_env(uses_rng, self.num_params())
+
+    def _make_chain_step(self):
+        body = self._make_step_body()
+
+        def chain(params, opt_state, state, it0, rng, inputs_k, labels_k):
+            def scan_body(carry, inp):
+                p, o, s, i = carry
+                xs, ys = inp
+                k = jax.random.fold_in(rng, i)
+                p, o, s, _, loss = body(p, o, s, it0 + i, k, xs, ys,
+                                        None, None, {})
+                return (p, o, s, i + 1), loss
+
+            (p, o, s, _), losses = jax.lax.scan(
+                scan_body,
+                (params, opt_state, state, jnp.asarray(0, jnp.int32)),
+                (inputs_k, labels_k))
+            return p, o, s, losses
+
+        return jax.jit(chain, donate_argnums=(0, 1, 2))
+
+    def _get_chain_step(self):
+        if getattr(self, "_chain_step_fn", None) is None:
+            self._chain_step_fn = self._make_chain_step()
+        return self._chain_step_fn
 
     def _initial_carries(self, batch: int) -> dict:
         if self._wrapped_rnn_vertices:
@@ -948,7 +987,35 @@ class ComputationGraph:
             source = data() if callable(data) else data
             tbptt = (self.conf.backprop_type == "tbptt"
                      and bool(self._time_distributed_inputs()))
+            chain_k = self._chain_k() if not (self.listeners or tbptt) else 0
+            buf: list = []
+
+            def flush(full: bool):
+                # full K-groups go out as ONE dispatch; tails use the
+                # per-step path (a different K = a fresh compile)
+                if full and len(buf) > 1:
+                    self._fit_chained(buf)
+                else:
+                    for bf, bl in buf:
+                        self.fit_batch((bf, bl, None, None))
+                buf.clear()
+
             for batch in self._iter_multi(source, batch_size):
+                f, l, fm, lm = batch
+                from deeplearning4j_tpu.nn.model import _batch_sig
+
+                chainable = (
+                    chain_k > 1 and fm is None and lm is None
+                    and l is not None and all(y is not None for y in l)
+                    and (not buf or _batch_sig(f + l)
+                         == _batch_sig(buf[0][0] + buf[0][1]))
+                )
+                if chainable:
+                    buf.append((f, l))
+                    if len(buf) == chain_k:
+                        flush(True)
+                    continue
+                flush(False)
                 if tbptt:
                     score = self._fit_tbptt(*batch)
                 else:
@@ -958,6 +1025,7 @@ class ComputationGraph:
                     bs = len(jax.tree_util.tree_leaves(batch[0])[0])
                     for l in self.listeners:
                         l.iteration_done(self, self.iteration, score, bs)
+            flush(False)
             for l in self.listeners:
                 l.on_epoch_end(self, self.epoch)
             self.epoch += 1
@@ -1078,6 +1146,19 @@ class ComputationGraph:
             nchunks += 1
             self.iteration += 1
         return total / max(nchunks, 1)
+
+    def _fit_chained(self, buf) -> None:
+        """One dispatch covering len(buf) train steps (lax.scan of the
+        step body over stacked batches; mirrors MultiLayerNetwork)."""
+        chain = self._get_chain_step()
+        ni, no = len(self.conf.inputs), len(self.conf.outputs)
+        fk = tuple(jnp.stack([b[0][i] for b in buf]) for i in range(ni))
+        lk = tuple(jnp.stack([b[1][i] for b in buf]) for i in range(no))
+        self.params, self.opt_state, self.state, _ = chain(
+            self.params, self.opt_state, self.state,
+            jnp.asarray(self.iteration, jnp.int32), self._next_rng(),
+            self._input_dict(fk), lk)
+        self.iteration += len(buf)
 
     def _next_rng(self):
         self._rng, k = jax.random.split(self._rng)

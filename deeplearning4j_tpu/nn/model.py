@@ -160,6 +160,30 @@ def _as_batch(batch):
     return batch, None, None, None
 
 
+def _chain_k_from_env(uses_rng: bool, n_params: int) -> int:
+    """Shared chained-fit gate for MultiLayerNetwork and ComputationGraph:
+    DL4J_TPU_CHAIN_STEPS forces a count (0 disables); "auto" chains 8 only
+    for rng-free models small enough to be dispatch-bound."""
+    import os as _os
+
+    env = _os.environ.get("DL4J_TPU_CHAIN_STEPS", "auto")
+    if env != "auto":
+        try:
+            return max(int(env), 0)
+        except ValueError:
+            return 0
+    return 8 if (not uses_rng and n_params < 2_000_000) else 0
+
+
+def _batch_sig(arrays) -> tuple:
+    """Shape+dtype signature used to decide whether two batches may share
+    one chained dispatch (same-shape different-dtype batches must NOT be
+    stacked: jnp.stack would silently dtype-promote, e.g. routing sparse
+    integer labels through the dense-loss path)."""
+    return tuple((np.shape(a), np.asarray(a).dtype.str)
+                 for a in arrays if a is not None)
+
+
 def _cast_labels(y, dtype):
     """Model-dtype cast that PRESERVES integer (sparse) class labels — the
     loss head's sparse path needs the integer dtype intact."""
@@ -442,16 +466,8 @@ class MultiLayerNetwork:
         DL4J_TPU_CHAIN_STEPS forces a count; "auto" chains 8 only for
         models that draw NO randomness (identical math to per-step) and
         are small enough to be dispatch-bound (docs/PERF.md LeNet)."""
-        import os as _os
-
-        env = _os.environ.get("DL4J_TPU_CHAIN_STEPS", "auto")
-        if env != "auto":
-            try:
-                return max(int(env), 0)
-            except ValueError:
-                return 0
         uses_rng = any(l.uses_rng() for l in self.layers)
-        return 8 if (not uses_rng and self.num_params() < 2_000_000) else 0
+        return _chain_k_from_env(uses_rng, self.num_params())
 
     def _fit_chained(self, buf) -> None:
         """One dispatch covering len(buf) train steps (lax.scan of the step
@@ -494,7 +510,8 @@ class MultiLayerNetwork:
                 chainable = (
                     chain_k > 1 and fm is None and lm is None
                     and not (tbptt and np.ndim(x) == 3)
-                    and (not buf or np.shape(x) == np.shape(buf[0][0]))
+                    and (not buf or _batch_sig((x, y))
+                         == _batch_sig((buf[0][0], buf[0][1])))
                 )
                 if chainable:
                     buf.append((x, y))

@@ -537,3 +537,71 @@ class TestGraphTbptt:
         x = rng.randn(2, T, 3).astype(np.float32)
         with pytest.raises(NotImplementedError, match="wrapper"):
             m.rnn_time_step(x[:, 0, :])
+
+
+class TestGraphChainedFit:
+    """CG fit() chains K steps per dispatch for rng-free small graphs
+    (mirrors MultiLayerNetwork's round-5 chained hot loop)."""
+
+    def test_chained_equals_per_step_exactly(self):
+        import os
+
+        import jax
+        rng_np = np.random.RandomState(0)
+        x = rng_np.rand(64, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng_np.randint(0, 3, 64)]
+
+        def mk():
+            return ComputationGraph(
+                ComputationGraphConfiguration.builder()
+                .add_inputs("in")
+                .set_input_types(InputType.feed_forward(4))
+                .add_layer("h", Dense(n_out=10, activation="tanh"), "in")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax"), "h")
+                .set_outputs("out")
+                .updater({"type": "adam", "lr": 0.01})
+                .seed(5).build()).init()
+
+        old = os.environ.get("DL4J_TPU_CHAIN_STEPS")
+        try:
+            os.environ["DL4J_TPU_CHAIN_STEPS"] = "0"
+            m_ref = mk()
+            m_ref.fit((x, y), epochs=4, batch_size=8)
+            os.environ["DL4J_TPU_CHAIN_STEPS"] = "4"
+            m_ch = mk()
+            m_ch.fit((x, y), epochs=4, batch_size=8)
+        finally:
+            if old is None:
+                os.environ.pop("DL4J_TPU_CHAIN_STEPS", None)
+            else:
+                os.environ["DL4J_TPU_CHAIN_STEPS"] = old
+        assert m_ch.iteration == m_ref.iteration == 32
+        for (pa, a), (_pb, b) in zip(
+                jax.tree_util.tree_leaves_with_path(m_ch.params),
+                jax.tree_util.tree_leaves_with_path(m_ref.params)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7,
+                err_msg=jax.tree_util.keystr(pa))
+
+    def test_multi_input_graph_chains(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_CHAIN_STEPS", "8")
+        rng_np = np.random.RandomState(1)
+        xa = rng_np.rand(32, 3).astype(np.float32)
+        xb = rng_np.rand(32, 5).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng_np.randint(0, 2, 32)]
+        conf = (ComputationGraphConfiguration.builder()
+                .add_inputs("a", "b")
+                .set_input_types(InputType.feed_forward(3), InputType.feed_forward(5))
+                .add_layer("da", Dense(n_out=6, activation="relu"), "a")
+                .add_layer("db", Dense(n_out=6, activation="relu"), "b")
+                .add_vertex("m", MergeVertex(), "da", "db")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax"), "m")
+                .set_outputs("out")
+                .updater({"type": "adam", "lr": 0.02})
+                .build())
+        m = ComputationGraph(conf).init()
+        assert m._chain_k() == 8
+        s0 = m.score(((xa, xb), y))
+        m.fit(((xa, xb), y), epochs=8, batch_size=4)   # 8 batches -> chained
+        assert m.iteration == 64
+        assert m.score(((xa, xb), y)) < s0
