@@ -27,4 +27,7 @@ run python tools/exp_transformer_mfu.py sweep 5   # 256/256
 run python tools/exp_transformer_mfu.py remat
 run python tools/exp_transformer_mfu.py opmix
 
+# 4. masked flash long-T envelope (VERDICT r4 #4 done-criterion)
+run python tools/exp_masked_flash.py 8192
+
 echo "CHIP SESSION DONE $(date)" >> "$LOG"
