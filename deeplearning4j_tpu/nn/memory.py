@@ -77,27 +77,61 @@ def _analyze(compiled) -> Dict[str, int]:
         return {}
 
 
-def memory_report(model, batch_size: int = 32) -> MemoryReport:
-    """Compile (without executing) the model's inference and train step for
-    ``batch_size`` and report exact compiled memory requirements."""
+def _dummy_for(it, batch_size: int, dtype):
+    if it.kind == "conv":
+        return jnp.zeros((batch_size, it.height, it.width, it.channels), dtype)
+    if it.kind == "recurrent":
+        return jnp.zeros((batch_size, it.timesteps or 16, it.size), dtype)
+    return jnp.zeros((batch_size, it.flat_size()), dtype)
+
+
+def _memory_report_cg(model, batch_size: int) -> MemoryReport:
+    """ComputationGraph variant (NetworkMemoryReport covers both network
+    classes in the reference): dummy per-input/per-output arrays from the
+    declared InputTypes, same compiled-executable analysis."""
     if model.params is None:
         model.init()
-    it = model.conf.input_type
-    if it.kind == "conv":
-        x_shape = (batch_size, it.height, it.width, it.channels)
-    elif it.kind == "recurrent":
-        x_shape = (batch_size, it.timesteps or 16, it.size)
-    else:
-        x_shape = (batch_size, it.flat_size())
-    x = jnp.zeros(x_shape, model.dtype)
-    out_t = model.output_type
-    if out_t.kind == "recurrent":
-        y_shape = (batch_size, x_shape[1], out_t.size)
-    elif out_t.kind == "conv":
-        y_shape = (batch_size, out_t.height, out_t.width, out_t.channels)
-    else:
-        y_shape = (batch_size, out_t.flat_size())
-    y = jnp.zeros(y_shape, model.dtype)
+    feats = tuple(_dummy_for(model.conf.input_types[n], batch_size,
+                             model.dtype) for n in model.conf.inputs)
+    labels = tuple(_dummy_for(t, batch_size, model.dtype)
+                   for t in model.output_types)
+    inputs = model._input_dict(feats)
+
+    def fwd(params, state, inputs):
+        acts, _, _, _ = model._forward(params, state, inputs, train=False,
+                                       rngs=None)
+        return tuple(acts[o] for o in model.conf.outputs)
+
+    inf = _analyze(jax.jit(fwd).lower(model.params, model.state,
+                                      inputs).compile())
+    step = model._make_step(False)
+    rng = jax.random.PRNGKey(0)
+    tr = _analyze(step.lower(
+        model.params, model.opt_state, model.state,
+        jnp.asarray(0, jnp.int32), rng, inputs, labels, None, None, {},
+    ).compile())
+    return MemoryReport(
+        model_class=type(model).__name__,
+        batch_size=batch_size,
+        params_bytes=_tree_bytes(model.params),
+        opt_state_bytes=_tree_bytes(model.opt_state),
+        inference=inf,
+        training=tr,
+    )
+
+
+def memory_report(model, batch_size: int = 32) -> MemoryReport:
+    """Compile (without executing) the model's inference and train step for
+    ``batch_size`` and report exact compiled memory requirements. Covers
+    MultiLayerNetwork and ComputationGraph (NetworkMemoryReport parity)."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    if isinstance(model, ComputationGraph):
+        return _memory_report_cg(model, batch_size)
+    if model.params is None:
+        model.init()
+    x = _dummy_for(model.conf.input_type, batch_size, model.dtype)
+    y = _dummy_for(model.output_type, batch_size, model.dtype)
 
     # inference executable
     def fwd(params, state, x):

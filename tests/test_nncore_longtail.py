@@ -281,3 +281,32 @@ class TestViterbiAndMovingWindow:
         import pytest
         with pytest.raises(ValueError, match="exceeds"):
             MovingWindowMatrix(m, 5, 2)
+
+
+class TestMemoryReportCG:
+    def test_cg_memory_report(self):
+        """NetworkMemoryReport covers ComputationGraph too (round 5):
+        multi-input DAG compiles and reports exact executable footprints."""
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph, ComputationGraphConfiguration, MergeVertex)
+        from deeplearning4j_tpu.nn.input_type import InputType
+        from deeplearning4j_tpu.nn.layers.core import Dense, OutputLayer
+        from deeplearning4j_tpu.nn.memory import memory_report
+
+        conf = (ComputationGraphConfiguration.builder()
+                .add_inputs("a", "b")
+                .set_input_types(InputType.feed_forward(3),
+                                 InputType.feed_forward(5))
+                .add_layer("da", Dense(n_out=6, activation="relu"), "a")
+                .add_layer("db", Dense(n_out=6, activation="relu"), "b")
+                .add_vertex("m", MergeVertex(), "da", "db")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax"), "m")
+                .set_outputs("out")
+                .updater({"type": "adam", "lr": 1e-3})
+                .build())
+        m = ComputationGraph(conf).init()
+        rep = memory_report(m, batch_size=8)
+        assert rep.model_class == "ComputationGraph"
+        assert rep.params_bytes > 0 and rep.opt_state_bytes > 0
+        assert rep.total_training_bytes() >= rep.params_bytes
+        assert "MemoryReport" in rep.to_string()
