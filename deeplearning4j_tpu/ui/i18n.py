@@ -24,6 +24,7 @@ FALLBACK_LANGUAGE = "en"
 
 _MESSAGES: Dict[str, Dict[str, str]] = {
     "en": {
+        "train.system": "System",
         "train.pagetitle": "deeplearning4j_tpu training UI",
         "train.overview.title": "Training overview",
         "train.session": "Session",
@@ -39,6 +40,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
                        "/tsne, or call UIServer.upload_tsne()."),
     },
     "ja": {
+        "train.system": "システム",
         "train.pagetitle": "deeplearning4j_tpu トレーニングUI",
         "train.overview.title": "トレーニング概要",
         "train.session": "セッション",
@@ -51,6 +53,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "tsne.points": "点",
     },
     "zh": {
+        "train.system": "系统",
         "train.pagetitle": "deeplearning4j_tpu 训练界面",
         "train.overview.title": "训练概览",
         "train.session": "会话",
@@ -63,6 +66,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "tsne.points": "个点",
     },
     "ko": {
+        "train.system": "시스템",
         "train.pagetitle": "deeplearning4j_tpu 훈련 UI",
         "train.overview.title": "훈련 개요",
         "train.session": "세션",
@@ -75,6 +79,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "tsne.points": "포인트",
     },
     "de": {
+        "train.system": "System",
         "train.pagetitle": "deeplearning4j_tpu Trainings-UI",
         "train.overview.title": "Trainingsübersicht",
         "train.session": "Sitzung",
@@ -87,6 +92,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "tsne.points": "Punkte",
     },
     "fr": {
+        "train.system": "Système",
         "train.pagetitle": "Interface d'entraînement deeplearning4j_tpu",
         "train.overview.title": "Vue d'ensemble de l'entraînement",
         "train.session": "Session",
@@ -99,6 +105,7 @@ _MESSAGES: Dict[str, Dict[str, str]] = {
         "tsne.points": "points",
     },
     "ru": {
+        "train.system": "Система",
         "train.pagetitle": "deeplearning4j_tpu — интерфейс обучения",
         "train.overview.title": "Обзор обучения",
         "train.session": "Сессия",

@@ -24,6 +24,14 @@ def _msg(key: str, lang=None) -> str:
     """Localized UI chrome string (ui/i18n.py, DefaultI18N parity)."""
     return I18N.get_instance().get_message(key, lang)
 
+
+def _kv_table(d: dict, keys=None) -> str:
+    """Escaped key/value <table> (the stats/system table renderer)."""
+    rows = "".join(
+        f"<tr><th>{html.escape(str(k))}</th><td>{html.escape(str(v))}</td></tr>"
+        for k, v in d.items() if keys is None or k in keys)
+    return f"<table>{rows}</table>"
+
 _W, _H, _PAD = 640, 220, 42
 
 
@@ -195,13 +203,10 @@ class UIServer:
         statics = storage.get_static_info(sid)
         parts = [f"<h2>{html.escape(msg('train.session'))} {html.escape(sid)}</h2>"]
         if statics:
-            s = statics[0]
-            rows = "".join(
-                f"<tr><th>{html.escape(str(k))}</th><td>{html.escape(str(v))}</td></tr>"
-                for k, v in s.items()
-                if k in ("model_class", "n_layers", "n_params", "backend", "devices")
-            )
-            parts.append(f"<table>{rows}</table>")
+            parts.append(_kv_table(
+                statics[0],
+                keys=("model_class", "n_layers", "n_params", "backend",
+                      "devices")))
         if not ups:
             return "".join(parts)
         its = [u["iteration"] for u in ups]
@@ -258,6 +263,44 @@ class UIServer:
         )
         return self
 
+    def render_system_html(self, lang: Optional[str] = None) -> str:
+        """/train/system (reference TrainModule's system tab): runtime and
+        per-session hardware/memory facts — JAX backend and devices in
+        place of the reference's JVM/GC telemetry, peak host RSS from the
+        OS (ru_maxrss: a lifetime high-water mark, kilobytes on Linux and
+        bytes on BSD/macOS)."""
+        import resource
+        import sys as _sys
+
+        import jax as _jax
+
+        msg = lambda k: _msg(k, lang)
+        devs = _jax.devices()
+        maxrss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        if _sys.platform == "darwin":
+            maxrss //= 1024                    # bytes -> KB
+        rows = {
+            "backend": _jax.default_backend(),
+            "devices": ", ".join(str(d) for d in devs),
+            "device count": len(devs),
+            "process count": _jax.process_count(),
+            "peak host RSS": f"{maxrss / 1024:.1f} MB",
+        }
+        parts = [f"<html><head><meta charset='utf-8'><style>{_CSS}</style>"
+                 f"<title>{html.escape(msg('train.pagetitle'))}</title>"
+                 f"</head><body><h1>{html.escape(msg('train.system'))}</h1>"
+                 + _kv_table(rows)]
+        for storage in self.storages:
+            for sid in storage.list_session_ids():
+                statics = storage.get_static_info(sid)
+                if not statics:
+                    continue
+                parts.append(
+                    f"<h2>{html.escape(msg('train.session'))} "
+                    f"{html.escape(sid)}</h2>" + _kv_table(statics[0]))
+        parts.append("</body></html>")
+        return "".join(parts)
+
     def render_tsne_html(self, lang: Optional[str] = None) -> str:
         msg = lambda k: _msg(k, lang)
         title = html.escape(msg("tsne.title"))
@@ -293,6 +336,9 @@ class UIServer:
                     # 5s meta-refresh so the browser polls while training
                     body = outer.render_html(refresh_seconds=5,
                                              lang=lang).encode()
+                    ctype = "text/html"
+                elif route == "/train/system":
+                    body = outer.render_system_html(lang=lang).encode()
                     ctype = "text/html"
                 elif route == "/tsne":
                     body = outer.render_tsne_html(lang=lang).encode()

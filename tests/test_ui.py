@@ -254,3 +254,23 @@ class TestI18N:
             assert "q" in ui._tsne_sets
         finally:
             ui.stop()
+
+    def test_system_page(self):
+        import urllib.request
+
+        from deeplearning4j_tpu.ui.server import UIServer
+        from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+        ui = UIServer()
+        st = InMemoryStatsStorage()
+        st.put_static_info({"session_id": "s1", "model_class": "M",
+                            "n_params": 7, "backend": "cpu"})
+        ui.attach(st)
+        ui.serve(port=0)
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{ui.port}/train/system").read().decode()
+            assert "System" in body and "backend" in body
+            assert "n_params" in body and "s1" in body
+        finally:
+            ui.stop()
