@@ -2,6 +2,8 @@
 LBFGS/CG/line-search solvers, memory_report, word-vector serialization,
 BoW/TF-IDF."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -310,3 +312,23 @@ class TestMemoryReportCG:
         assert rep.params_bytes > 0 and rep.opt_state_bytes > 0
         assert rep.total_training_bytes() >= rep.params_bytes
         assert "MemoryReport" in rep.to_string()
+
+
+class TestCompileCache:
+    def test_env_gating(self, monkeypatch, tmp_path):
+        from deeplearning4j_tpu.utils import compile_cache as cc
+
+        monkeypatch.delenv("DL4J_TPU_COMPILE_CACHE", raising=False)
+        assert cc.enable_compilation_cache_from_env() is None
+        monkeypatch.setenv("DL4J_TPU_COMPILE_CACHE", str(tmp_path / "xc"))
+        d = cc.enable_compilation_cache_from_env()
+        assert d == str(tmp_path / "xc") and os.path.isdir(d)
+        import jax
+        assert jax.config.jax_compilation_cache_dir == d
+
+    def test_empty_value_means_default_dir(self, monkeypatch):
+        from deeplearning4j_tpu.utils import compile_cache as cc
+
+        monkeypatch.setenv("DL4J_TPU_COMPILE_CACHE", "")
+        d = cc.enable_compilation_cache_from_env()
+        assert d == cc._DEFAULT and os.path.isdir(d)
