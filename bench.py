@@ -309,6 +309,8 @@ def bench_lstm_char_rnn():
     x = jnp.asarray(np.eye(vocab, dtype=np.float32)[ids])
     y = jnp.asarray(np.eye(vocab, dtype=np.float32)[np.roll(ids, -1, axis=1)])
 
+    failed_arms = {}
+
     def measure(policy):
         """One arm (scan or the round-5 weight-stationary fused kernel);
         the env flag is read at trace time, so a fresh model+compile per
@@ -337,6 +339,9 @@ def bench_lstm_char_rnn():
             dt, steps = _timed(run, warmup_steps=5, steps=50)
             return steps * batch * timesteps / dt, compiled
         except Exception as e:  # pragma: no cover - hardware-dependent
+            # recorded in the JSON result too — a broken fused kernel must
+            # be visible in BENCH output, not just a stderr note
+            failed_arms[policy] = f"{type(e).__name__}: {e}"[:200]
             print(f"# lstm arm {policy} failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
             return None
@@ -365,6 +370,8 @@ def bench_lstm_char_rnn():
         "lstm_path": best,
         "arms_tokens_per_sec": {k: round(v[0], 1) for k, v in arms.items()},
     }
+    if failed_arms:
+        out["failed_arms"] = failed_arms
     out.update(_mfu_from_cost(compiled, tps / (batch * timesteps)))
     return out
 
@@ -544,12 +551,88 @@ def bench_transformer():
     return out
 
 
+def bench_serving_mixed():
+    """Mixed-batch-size serving — the shape-bucketing tentpole's probe.
+
+    Requests drawn from a fixed size list flow through ParallelInference
+    batched mode; without bucketing every distinct coalesced batch size
+    compiles a fresh inference executable, with it the ladder collapses
+    them onto a handful of buckets. Reports WARM throughput (every bucket
+    pre-touched) plus the observed trace/compile count and bucket-hit
+    histogram from the utils.bucketing telemetry, so the trajectory tracks
+    compile-count regressions alongside examples/sec."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from deeplearning4j_tpu.nn.input_type import InputType
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.model import (
+        MultiLayerConfiguration, MultiLayerNetwork)
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+    from deeplearning4j_tpu.utils import bucketing
+
+    n_feat, hidden, classes = 32, 256, 10
+    sizes = [1, 2, 3, 5, 7, 9, 12, 17, 21, 27]
+    rounds = 8 if SMOKE else 50
+    if SMOKE:
+        hidden = 16
+    conf = MultiLayerConfiguration(
+        layers=(Dense(n_out=hidden, activation="relu"),
+                OutputLayer(n_out=classes, activation="softmax")),
+        input_type=InputType.feed_forward(n_feat),
+        updater={"type": "sgd", "lr": 0.05},
+    )
+    model = MultiLayerNetwork(conf).init()
+    rs = np.random.RandomState(0)
+    reqs = [rs.rand(s, n_feat).astype(np.float32) for s in sizes]
+
+    tel = bucketing.telemetry()
+    tel.reset()
+    max_bs = 64
+    pi = ParallelInference(model, mode="batched", max_batch_size=max_bs)
+    try:
+        # warmup: touch every ladder rung up to the coalesce cap — the
+        # worker merges queued requests, so a coalesced total can land on
+        # any bucket <= max_batch_size, not just the per-request ones.
+        # Pre-compiling every rung means the timed window adds ZERO traces.
+        rungs, n = [], 1
+        while n <= max_bs:
+            b = min(bucketing.bucket_size(n), max_bs)
+            rungs.append(b)
+            n = b + 1
+        for b in rungs:
+            model.output(np.zeros((b, n_feat), np.float32))
+        compiles_warm = tel.compiles("mln.output")
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            t0 = time.perf_counter()
+            futs = [pool.submit(pi.output, reqs[i % len(reqs)])
+                    for i in range(rounds * len(sizes))]
+            total = sum(len(f.result()) for f in futs)
+            dt = time.perf_counter() - t0
+    finally:
+        pi.shutdown()
+    snap = tel.snapshot()
+    return {
+        "metric": "serving_mixed_batch_throughput",
+        "value": round(total / dt, 1),
+        "unit": "examples/sec",
+        "distinct_request_sizes": len(set(sizes)),
+        "distinct_buckets": len(tel.buckets_used("pi.batched")),
+        "buckets_warmed": len(set(rungs)),
+        "observed_compiles": tel.compiles("mln.output"),
+        "compiles_after_warmup": tel.compiles("mln.output") - compiles_warm,
+        "bucket_hits": snap["bucket_hits"],
+        "padded_examples": snap["padded_examples"],
+        "real_examples": snap["real_examples"],
+    }
+
+
 _BENCHES = {
     "lenet5": bench_lenet5,
     "resnet50": bench_resnet50,
     "lstm": bench_lstm_char_rnn,
     "word2vec": bench_word2vec,
     "transformer": bench_transformer,
+    "serving": bench_serving_mixed,
 }
 
 

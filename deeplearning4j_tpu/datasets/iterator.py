@@ -295,3 +295,112 @@ class ShardedDataSetIterator(DataSetIterator):
     def reset(self):
         if hasattr(self.base, "reset"):
             self.base.reset()
+
+
+def _device_put_item(item, device=None):
+    """Move every array leaf of a batch onto ``device`` (default device when
+    None). DataSet/MultiDataSet items are rebuilt around transferred member
+    arrays; non-array leaves pass through untouched; None members survive
+    (tree_map treats None as structure)."""
+    import jax
+
+    def put(a):
+        if a is None or not (isinstance(a, (np.ndarray, jax.Array))
+                             or hasattr(a, "__array__")):
+            return a
+        return jax.device_put(a, device)
+
+    if isinstance(item, (DataSet, MultiDataSet)):
+        # bypass __init__: its np.asarray() normalization would pull the
+        # freshly transferred arrays straight back to host
+        new = item.__class__.__new__(item.__class__)
+        new.__dict__.update(
+            {k: jax.tree_util.tree_map(put, v) for k, v in item.__dict__.items()})
+        return new
+    return jax.tree_util.tree_map(put, item)
+
+
+def prefetch_to_device(iterable, depth: int = 2, device=None):
+    """Generator: yield ``iterable``'s batches with array leaves already on
+    device, transferred by a background thread ``depth`` batches ahead.
+
+    ``jax.device_put`` is async, so with depth=2 this is classic double
+    buffering: batch N+1's host→device copy overlaps batch N's compute
+    instead of serializing with it (the AsyncDataSetIterator above only
+    hides host ETL — the transfer itself still sat on the critical path).
+    The producer thread blocks on a bounded queue, so at most ``depth``
+    batches are resident beyond the one in use; closing the generator early
+    (break / .close()) stops and joins the producer."""
+    import jax  # deferred: importing this module must not init a backend
+
+    if depth < 1:
+        raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    sentinel = object()
+    stop = threading.Event()
+    err: List[BaseException] = []
+
+    def worker():
+        try:
+            for item in iterable:
+                item = _device_put_item(item, device)
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as e:  # surface producer errors to the consumer
+            err.append(e)
+        finally:
+            while not stop.is_set():
+                try:
+                    q.put(sentinel, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+    finally:
+        stop.set()
+        while True:  # unblock a producer stuck on a full queue
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        t.join(timeout=5)
+    if err:
+        raise err[0]
+
+
+class DevicePrefetchIterator(DataSetIterator):
+    """Device-side double buffering over any batch iterable (the fit() loops
+    use the ``prefetch_to_device`` generator directly; this class is the
+    composable DataSetIterator face of the same machinery)."""
+
+    def __init__(self, base: Iterable, depth: int = 2, device=None):
+        super().__init__(getattr(base, "batch_size", 32))
+        self.base = base
+        self.depth = depth
+        self.device = device
+
+    def __iter__(self):
+        src = (self.base() if callable(self.base)
+               and not hasattr(self.base, "__iter__") else self.base)
+        for item in prefetch_to_device(src, depth=self.depth, device=self.device):
+            if self.pre_processor is not None and isinstance(item, DataSet):
+                item = _apply_pp(self.pre_processor, item)
+            yield item
+
+    def reset(self):
+        if hasattr(self.base, "reset"):
+            self.base.reset()

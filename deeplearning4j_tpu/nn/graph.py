@@ -35,8 +35,9 @@ import numpy as np
 from deeplearning4j_tpu.nn.config import LayerConfig, layer_from_dict, _encode_value
 from deeplearning4j_tpu.nn.input_type import InputType
 from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrent
-from deeplearning4j_tpu.nn.model import _cast_input, _cast_labels
+from deeplearning4j_tpu.nn.model import _cast_input, _cast_labels, _sig_dtype
 from deeplearning4j_tpu.nn.preprocessors import infer_preprocessor
+from deeplearning4j_tpu.utils import bucketing
 from deeplearning4j_tpu.train.updaters import (
     apply_gradient_normalization,
     make_updater,
@@ -582,6 +583,26 @@ class _RuntimeVertex:
     config: Any                          # resolved (n_in inferred) layer/vertex
 
 
+def _tbptt_slice_t(x, sl, T, kind):
+    """tBPTT time-axis chunking rule for one array.
+
+    feat: inputs DECLARED recurrent chunk on axis 1 — [B,T,F] float streams
+    and [B,T] integer token-id streams alike (kind=="feat_td"); statics pass
+    whole — in particular a static 3-D side input whose middle dim happens
+    to equal T (kind=="feat") must NOT be silently time-chunked.
+    label: [B,T,C] one-hot or [B,T] sparse-integer. mask: [B,T]."""
+    if x is None:
+        return None
+    nd = np.ndim(x)
+    if nd == 3 and x.shape[1] == T and kind in ("feat_td", "label", "mask"):
+        return x[:, sl]
+    if nd == 2 and x.shape[1] == T:
+        if kind in ("mask", "feat_td") or (
+                kind == "label" and np.dtype(_sig_dtype(x)).kind in "iu"):
+            return x[:, sl]
+    return x
+
+
 def _toposort(conf: ComputationGraphConfiguration) -> List[str]:
     """Kahn's algorithm over vertex names (ComputationGraph.topologicalOrder
     equivalent, computed once at build)."""
@@ -679,6 +700,12 @@ class ComputationGraph:
         ]
         if not self._loss_vertices:
             self._loss_vertices = []  # inference-only graph is allowed
+        # Stack/Unstack split or join the BATCH axis into fixed segments —
+        # padding rows would land in the wrong branch, so batch bucketing
+        # (output()) must stay off for these graphs
+        self._has_batch_vertices = any(
+            isinstance(self.rt[name].config, (StackVertex, UnstackVertex))
+            for name in self.topo_order)
 
     # -- init --------------------------------------------------------------
     def init(self, seed: Optional[int] = None) -> "ComputationGraph":
@@ -830,6 +857,9 @@ class ComputationGraph:
 
         def step(params, opt_state, state, it, rng, inputs, labels, fmasks, lmasks,
                  carries, ex_weight=None):
+            # python body runs once per trace → counts actual compiles
+            bucketing.telemetry().record_trace(
+                "cg.step", np.shape(next(iter(inputs.values()))))
             rngs = list(jax.random.split(rng, len(order)))
 
             def loss_fn(p):
@@ -989,6 +1019,13 @@ class ComputationGraph:
                      and bool(self._time_distributed_inputs()))
             chain_k = self._chain_k() if not (self.listeners or tbptt) else 0
             buf: list = []
+            # pad every batch (incl. the partial tail) to ONE row count with
+            # a uniform ew/lmask calling convention → one compiled step
+            # (mirrors MultiLayerNetwork.fit); the chained path needs bare
+            # (f, l) batches, so it opts out
+            pad_target = (self._fit_pad_target_multi(source, batch_size)
+                          if chain_k <= 1 and not tbptt
+                          and bucketing.bucketing_enabled() else None)
 
             def flush(full: bool):
                 # full K-groups go out as ONE dispatch; tails use the
@@ -1000,10 +1037,25 @@ class ComputationGraph:
                         self.fit_batch((bf, bl, None, None))
                 buf.clear()
 
-            for batch in self._iter_multi(source, batch_size):
-                f, l, fm, lm = batch
-                from deeplearning4j_tpu.nn.model import _batch_sig
+            def batches():
+                for f, l, fm, lm in self._iter_multi(source, batch_size):
+                    if pad_target is not None:
+                        yield bucketing.pad_fit_multi(
+                            f, l, fm, lm, pad_target, site="cg.fit")
+                    else:
+                        yield (f, l, fm, lm, None)
 
+            stream = batches()
+            from deeplearning4j_tpu.nn.model import (
+                _batch_sig, _device_prefetch_enabled)
+            if _device_prefetch_enabled():
+                # overlap next batch's host→device transfer with this step's
+                # compute (double buffering); AFTER padding, which is host-side
+                from deeplearning4j_tpu.datasets.iterator import prefetch_to_device
+
+                stream = prefetch_to_device(stream)
+            for f, l, fm, lm, ew in stream:
+                batch = (f, l, fm, lm)
                 chainable = (
                     chain_k > 1 and fm is None and lm is None
                     and l is not None and all(y is not None for y in l)
@@ -1019,10 +1071,11 @@ class ComputationGraph:
                 if tbptt:
                     score = self._fit_tbptt(*batch)
                 else:
-                    score = self.fit_batch(batch)
+                    score = self.fit_batch(batch, ew=ew)
                 if self.listeners:
                     score = float(score)
-                    bs = len(jax.tree_util.tree_leaves(batch[0])[0])
+                    bs = (len(jax.tree_util.tree_leaves(batch[0])[0])
+                          if ew is None else int(np.asarray(ew).sum()))
                     for l in self.listeners:
                         l.iteration_done(self, self.iteration, score, bs)
             flush(False)
@@ -1031,18 +1084,13 @@ class ComputationGraph:
             self.epoch += 1
         return self
 
-    def _iter_multi(self, data, batch_size):
-        """Yield MultiDataSet batches. A bare (features, labels) pair of
-        arrays/tuples is minibatched when batch_size is given.
-
-        Disambiguation (single batch vs iterable of batches) uses the model's
-        input arity: a single batch's features must be one array (1-input
-        nets) or a tuple of exactly len(inputs) arrays."""
+    def _is_single_multibatch(self, data) -> bool:
+        """True when ``data`` is ONE in-memory MultiDataSet-like batch (not an
+        iterable of batches). Disambiguation uses the model's input arity: a
+        single batch's features must be one array (1-input nets) or a tuple
+        of exactly len(inputs) arrays."""
         def _is_arr(v):
             return isinstance(v, (np.ndarray, jax.Array)) or hasattr(v, "__array__")
-
-        if hasattr(data, "as_tuple"):  # datasets.DataSet / MultiDataSet
-            data = data.as_tuple()
 
         ni = len(self.conf.inputs)
 
@@ -1055,9 +1103,33 @@ class ComputationGraph:
                 and all(_is_arr(e) for e in f)
             )
 
-        if (isinstance(data, dict)
+        return (isinstance(data, dict)
                 or (isinstance(data, (tuple, list)) and 2 <= len(data) <= 4
-                    and _features_like(data[0]))):
+                    and _features_like(data[0])))
+
+    def _fit_pad_target_multi(self, data, batch_size) -> Optional[int]:
+        """Uniform per-batch row count for fit() over one in-memory batch
+        source, or None (mirrors model._fit_pad_target: only worth padding
+        when minibatching leaves a partial tail that would otherwise trace a
+        second training executable)."""
+        if batch_size is None:
+            return None
+        if hasattr(data, "as_tuple"):
+            data = data.as_tuple()
+        if self._is_single_multibatch(data):
+            f, _, _, _ = self._as_multi_batch(data)
+            n = f[0].shape[0]
+            if n > batch_size and n % batch_size != 0:
+                return batch_size
+        return None
+
+    def _iter_multi(self, data, batch_size):
+        """Yield MultiDataSet batches. A bare (features, labels) pair of
+        arrays/tuples is minibatched when batch_size is given."""
+        if hasattr(data, "as_tuple"):  # datasets.DataSet / MultiDataSet
+            data = data.as_tuple()
+
+        if self._is_single_multibatch(data):
             f, l, fm, lm = self._as_multi_batch(data)
             n = f[0].shape[0]
             if batch_size is None or batch_size >= n:
@@ -1104,22 +1176,7 @@ class ComputationGraph:
         B = f[0].shape[0]
         carries = self._initial_carries(B)
 
-        def slice_t(x, sl, kind):
-            # feat: inputs DECLARED recurrent chunk on axis 1 — [B,T,F]
-            # float streams and [B,T] integer token-id streams alike
-            # (kind=="feat_td"); statics pass whole. label: [B,T,C] one-hot
-            # or [B,T] sparse-integer. mask: [B,T].
-            if x is None:
-                return None
-            nd = np.ndim(x)
-            if nd == 3 and x.shape[1] == T:
-                return x[:, sl]
-            if nd == 2 and x.shape[1] == T:
-                if kind in ("mask", "feat_td") or (
-                        kind == "label" and np.asarray(x).dtype.kind in "iu"):
-                    return x[:, sl]
-            return x
-
+        slice_t = lambda x, sl, kind: _tbptt_slice_t(x, sl, T, kind)
         total, nchunks = 0.0, 0
         for t0 in range(0, T, L):
             sl = slice(t0, min(t0 + L, T))
@@ -1167,18 +1224,42 @@ class ComputationGraph:
     # -- inference ---------------------------------------------------------
     def output(self, *xs, fmasks=None):
         """Outputs of all output vertices (ComputationGraph.output:1754).
-        Returns a single array when the graph has one output."""
+        Returns a single array when the graph has one output.
+
+        Batch rows are padded up to the shared bucket ladder before dispatch
+        (and sliced back off) so mixed caller batch sizes share one compiled
+        executable per bucket; skipped for graphs with Stack/Unstack
+        vertices, whose batch-axis arithmetic padding would corrupt.
+        Disable via DL4J_TPU_BUCKETING=0."""
         if len(xs) == 1 and isinstance(xs[0], (tuple, list)):
             xs = tuple(xs[0])
         feats = tuple(_cast_input(x, self.dtype) for x in xs)
         fm = self._norm_multi(fmasks, len(self.conf.inputs)) if fmasks is not None else None
         if self._output_fn is None:
             def fwd(params, state, inputs, masks):
+                # python body runs once per trace → counts actual compiles
+                bucketing.telemetry().record_trace(
+                    "cg.output", np.shape(next(iter(inputs.values()))))
                 acts, _, _, _ = self._forward(params, state, inputs, train=False,
                                               rngs=None, masks=masks)
                 return tuple(acts[o] for o in self.conf.outputs)
 
             self._output_fn = jax.jit(fwd)
+        n = feats[0].shape[0] if feats else 0
+        if (bucketing.bucketing_enabled() and n > 0
+                and not self._has_batch_vertices):
+            target = bucketing.bucket_size(n)
+            bucketing.telemetry().record_hit("cg.output", n, target)
+            if target > n:
+                feats = tuple(bucketing.pad_rows_zero(x, target) for x in feats)
+                if fm is not None:
+                    fm = tuple(bucketing.pad_rows_zero(m, target)
+                               if m is not None else None for m in fm)
+                outs = self._output_fn(self.params, self.state,
+                                       self._input_dict(feats),
+                                       self._mask_dict(fm))
+                outs = tuple(bucketing.unpad(o, n) for o in outs)
+                return outs[0] if len(outs) == 1 else outs
         outs = self._output_fn(self.params, self.state, self._input_dict(feats),
                                self._mask_dict(fm))
         return outs[0] if len(outs) == 1 else outs

@@ -39,6 +39,39 @@ def _mesh_has_axis(axis: str) -> bool:
     return mesh is not None and axis in mesh.shape and mesh.shape[axis] > 1
 
 
+_FLASH_BLOCKS: Dict[str, int] = {}
+
+
+def _flash_block(var: str, default: int) -> int:
+    """Validated value of a DL4J_TPU_FLASH_BLOCK_{Q,K} env knob, parsed ONCE
+    per process and cached. A non-integer or non-positive value raises a
+    ValueError naming the variable instead of an opaque int() traceback deep
+    inside a trace.
+
+    The cached value is baked into the kernel grid at the FIRST trace of the
+    flash path — changing the env var later in the process affects neither
+    already-compiled executables nor future traces (the cache pins the first
+    parse precisely so one process can never mix grids silently)."""
+    if var not in _FLASH_BLOCKS:
+        import os as _os
+
+        raw = _os.environ.get(var)
+        if raw is None:
+            _FLASH_BLOCKS[var] = default
+        else:
+            try:
+                val = int(raw)
+            except ValueError:
+                raise ValueError(
+                    f"{var} must be an integer block size (rows per flash "
+                    f"kernel tile), got {raw!r}")
+            if val <= 0:
+                raise ValueError(
+                    f"{var} must be a positive block size, got {raw!r}")
+            _FLASH_BLOCKS[var] = val
+    return _FLASH_BLOCKS[var]
+
+
 @register_layer("positional_embedding")
 @dataclass
 class PositionalEmbedding(LayerConfig):
@@ -124,8 +157,6 @@ class MultiHeadAttention(LayerConfig):
                 head_axis=head_axis, use_flash=ring_flash
             )
         if self.use_flash in ("auto", True):
-            import os as _os
-
             from deeplearning4j_tpu.ops.flash_attention import flash_attention
 
             on_tpu = jax.default_backend() == "tpu"
@@ -133,10 +164,11 @@ class MultiHeadAttention(LayerConfig):
                 # off-TPU (interpreter) the compiled XLA-remat backward is
                 # far faster than three interpreted Pallas kernels; kmask
                 # loads one [1, block_k] validity row per key block in-kernel.
-                # Block sizes are env-tunable for perf sweeps (read at trace
-                # time; 128/128 is the measured default).
-                bq = int(_os.environ.get("DL4J_TPU_FLASH_BLOCK_Q", "128"))
-                bk = int(_os.environ.get("DL4J_TPU_FLASH_BLOCK_K", "128"))
+                # Block sizes are env-tunable for perf sweeps; validated and
+                # captured at first use (see _flash_block); 128/128 is the
+                # measured default.
+                bq = _flash_block("DL4J_TPU_FLASH_BLOCK_Q", 128)
+                bk = _flash_block("DL4J_TPU_FLASH_BLOCK_K", 128)
                 return flash_attention(q, k, v, kmask=kmask,
                                        causal=self.causal,
                                        block_q=bq, block_k=bk,

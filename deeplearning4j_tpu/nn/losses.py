@@ -208,8 +208,10 @@ def per_example_scores(
                 return jnp.sum(ce * mask, axis=-1)
             if preact.ndim == 3:
                 # dense convention: per-example score sums over time
-                return jnp.sum(ce, axis=-1)
+                ce = jnp.sum(ce, axis=-1)
             if mask is not None:
+                # [B] example mask (incl. padding validity weights) applies
+                # after the time sum, same as the dense rank-3 path
                 ce = ce * mask.reshape(ce.shape)
             return ce  # [B]
         elem = -labels * logp

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.nn.config import LayerConfig, layer_from_dict, _encode_value
+from deeplearning4j_tpu.utils import bucketing
 from deeplearning4j_tpu.nn.input_type import InputType
 from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrent
 from deeplearning4j_tpu.nn.preprocessors import infer_preprocessor
@@ -160,6 +161,14 @@ def _as_batch(batch):
     return batch, None, None, None
 
 
+# Above this parameter count, "auto" never chains: big models are
+# compute-bound, so amortizing dispatch buys nothing and the stacked
+# [K, B, ...] batch just costs memory.
+CHAIN_AUTO_PARAM_LIMIT = 2_000_000
+
+_CHAIN_RNG_WARNED = False
+
+
 def _chain_k_from_env(uses_rng: bool, n_params: int) -> int:
     """Shared chained-fit gate for MultiLayerNetwork and ComputationGraph:
     DL4J_TPU_CHAIN_STEPS forces a count (0 disables); "auto" chains 8 only
@@ -169,10 +178,31 @@ def _chain_k_from_env(uses_rng: bool, n_params: int) -> int:
     env = _os.environ.get("DL4J_TPU_CHAIN_STEPS", "auto")
     if env != "auto":
         try:
-            return max(int(env), 0)
+            k = max(int(env), 0)
         except ValueError:
             return 0
-    return 8 if (not uses_rng and n_params < 2_000_000) else 0
+        if k > 1 and uses_rng:
+            global _CHAIN_RNG_WARNED
+            if not _CHAIN_RNG_WARNED:
+                _CHAIN_RNG_WARNED = True
+                import warnings
+
+                warnings.warn(
+                    f"DL4J_TPU_CHAIN_STEPS={env} forces chained dispatch on a "
+                    "model that draws randomness (dropout/weight noise): "
+                    "per-step rngs derive as fold_in(rng, i) inside the "
+                    "chain, a different-but-equivalent stream from the "
+                    "per-step path, so losses will not be bitwise "
+                    "reproducible against unchained runs.")
+        return k
+    return 8 if (not uses_rng and n_params < CHAIN_AUTO_PARAM_LIMIT) else 0
+
+
+def _sig_dtype(a):
+    # prefer the dtype attribute: np.asarray on a device array would pull
+    # it back to host just to read metadata (hurts the prefetched-fit path)
+    dt = getattr(a, "dtype", None)
+    return np.dtype(dt if dt is not None else np.asarray(a).dtype).str
 
 
 def _batch_sig(arrays) -> tuple:
@@ -180,7 +210,7 @@ def _batch_sig(arrays) -> tuple:
     one chained dispatch (same-shape different-dtype batches must NOT be
     stacked: jnp.stack would silently dtype-promote, e.g. routing sparse
     integer labels through the dense-loss path)."""
-    return tuple((np.shape(a), np.asarray(a).dtype.str)
+    return tuple((np.shape(a), _sig_dtype(a))
                  for a in arrays if a is not None)
 
 
@@ -215,6 +245,34 @@ def _iter_batches(data, batch_size=None):
         return
     for b in data:
         yield _as_batch(b)
+
+
+def _fit_pad_target(source, batch_size) -> Optional[int]:
+    """Uniform per-batch row count for a fit() over in-memory arrays, or None.
+
+    When minibatching arrays whose length is not a multiple of batch_size,
+    the final partial batch would otherwise trace a SECOND training
+    executable just for its odd shape. Returns batch_size in that case so
+    every batch — including the tail, padded with zero example-weights — runs
+    through one executable. Streaming iterables return None: their batch
+    shapes aren't knowable up front, and padding only the surprise tail
+    would still cost the extra ew/lmask trace it tries to avoid."""
+    if batch_size is None:
+        return None
+    if hasattr(source, "as_tuple"):
+        source = source.as_tuple()
+    if (isinstance(source, (tuple, list)) and len(source) >= 2
+            and not isinstance(source[0], (tuple, list, dict))):
+        n = len(source[0])
+        if n > batch_size and n % batch_size != 0:
+            return batch_size
+    return None
+
+
+def _device_prefetch_enabled() -> bool:
+    import os as _os
+
+    return _os.environ.get("DL4J_TPU_DEVICE_PREFETCH", "1") != "0"
 
 
 class MultiLayerNetwork:
@@ -379,6 +437,8 @@ class MultiLayerNetwork:
 
         def step(params, opt_state, state, it, rng, x, y, fmask, lmask, carries,
                  ex_weight=None):
+            # python body runs once per trace → counts actual compiles
+            bucketing.telemetry().record_trace("mln.step", np.shape(x))
             rngs = list(jax.random.split(rng, len(layers)))
 
             def loss_fn(p):
@@ -495,6 +555,12 @@ class MultiLayerNetwork:
                 l.on_epoch_start(self, self.epoch)
             source = data() if callable(data) else data
             buf: list = []
+            # pad every batch (incl. the partial tail) to ONE row count with
+            # a uniform ew/lmask calling convention → one compiled step. The
+            # chained path needs bare (x, y) batches, so it opts out.
+            pad_target = (_fit_pad_target(source, batch_size)
+                          if sgd and chain_k <= 1
+                          and bucketing.bucketing_enabled() else None)
 
             def flush(full: bool):
                 # full K-groups go out as ONE dispatch; tails use the
@@ -506,7 +572,22 @@ class MultiLayerNetwork:
                         self._fit_batch(bx, by, None, None)
                 buf.clear()
 
-            for x, y, fm, lm in _iter_batches(source, batch_size):
+            def batches():
+                for x, y, fm, lm in _iter_batches(source, batch_size):
+                    if pad_target is not None and not (tbptt and np.ndim(x) == 3):
+                        yield bucketing.pad_fit_batch(
+                            x, y, fm, lm, pad_target, site="mln.fit")
+                    else:
+                        yield (x, y, fm, lm, None)
+
+            stream = batches()
+            if sgd and _device_prefetch_enabled():
+                # overlap next batch's host→device transfer with this step's
+                # compute (double buffering); AFTER padding, which is host-side
+                from deeplearning4j_tpu.datasets.iterator import prefetch_to_device
+
+                stream = prefetch_to_device(stream)
+            for x, y, fm, lm, ew in stream:
                 chainable = (
                     chain_k > 1 and fm is None and lm is None
                     and not (tbptt and np.ndim(x) == 3)
@@ -524,13 +605,15 @@ class MultiLayerNetwork:
                 elif tbptt and np.ndim(x) == 3:
                     score = self._fit_tbptt(x, y, fm, lm)
                 else:
-                    score = self._fit_batch(x, y, fm, lm)
+                    score = self._fit_batch(x, y, fm, lm, ew=ew)
                 # score is a device scalar; only sync the host when a
                 # listener actually consumes it (keeps dispatch async)
                 if self.listeners:
                     score = float(score)
+                    n_real = (len(x) if ew is None
+                              else int(np.asarray(ew).sum()))
                     for l in self.listeners:
-                        l.iteration_done(self, self.iteration, score, len(x))
+                        l.iteration_done(self, self.iteration, score, n_real)
             flush(False)
             for l in self.listeners:
                 l.on_epoch_end(self, self.epoch)
@@ -589,7 +672,7 @@ class MultiLayerNetwork:
             # time-sliced labels: one-hot [B,T,C] AND sparse integer [B,T];
             # rank-2 FLOAT labels (sequence-level heads) pass through whole
             y_sliced = (y is not None and (np.ndim(y) == 3 or (
-                np.ndim(y) == 2 and np.asarray(y).dtype.kind in "iu")))
+                np.ndim(y) == 2 and np.dtype(_sig_dtype(y)).kind in "iu")))
             yc = _cast_labels(y[:, sl] if y_sliced else y, self.dtype)
             fmc = jnp.asarray(fm[:, sl], self.dtype) if fm is not None else None
             lmc = jnp.asarray(lm[:, sl], self.dtype) if lm is not None else None
@@ -608,17 +691,34 @@ class MultiLayerNetwork:
     # -- inference ---------------------------------------------------------
     def output(self, x, train: bool = False, fmask=None):
         """Final-layer post-activation output (MultiLayerNetwork.output:2005),
-        jit-compiled inference path."""
+        jit-compiled inference path.
+
+        Batch rows are padded up to the shared bucket ladder before dispatch
+        (and sliced back off) so mixed caller batch sizes share one compiled
+        executable per bucket — inference is row-independent (BatchNorm uses
+        running stats when train=False), so zero-pad rows are dead compute,
+        not a numerics change. Disable via DL4J_TPU_BUCKETING=0."""
         if self._output_fn is None:
             def fwd(params, state, x, fmask):
+                # python body runs once per trace → counts actual compiles
+                bucketing.telemetry().record_trace("mln.output", np.shape(x))
                 a, _, _, _, _ = self._forward(params, state, x, train=False, rngs=None,
                                               fmask=fmask)
                 return a
 
             self._output_fn = jax.jit(fwd)
-        return self._output_fn(self.params, self.state,
-                               _cast_input(x, self.dtype),
-                               jnp.asarray(fmask, self.dtype) if fmask is not None else None)
+        x = _cast_input(x, self.dtype)
+        fmask = jnp.asarray(fmask, self.dtype) if fmask is not None else None
+        n = x.shape[0]
+        if bucketing.bucketing_enabled() and n > 0:
+            target = bucketing.bucket_size(n)
+            bucketing.telemetry().record_hit("mln.output", n, target)
+            if target > n:
+                x = bucketing.pad_rows_zero(x, target)
+                fmask = bucketing.pad_rows_zero(fmask, target)
+                return bucketing.unpad(
+                    self._output_fn(self.params, self.state, x, fmask), n)
+        return self._output_fn(self.params, self.state, x, fmask)
 
     def predict(self, x) -> np.ndarray:
         return np.asarray(self.output(x)).argmax(axis=-1)

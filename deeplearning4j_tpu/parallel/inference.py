@@ -16,6 +16,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..utils import bucketing
+
 
 class _Pending:
     __slots__ = ("x", "event", "result")
@@ -29,16 +31,27 @@ class _Pending:
 class ParallelInference:
     """Batched inference front-end.
 
-    ``mode``: "inplace" (call straight through) or "batched" (coalesce up to
-    ``max_batch_size`` queued requests into one device call).
+    ``mode``: "inplace" (call straight through) or "batched" (coalesce queued
+    requests into one device call of at most ``max_batch_size`` examples; a
+    single oversized request still dispatches whole).
+
+    ``bucket``: pad each drained batch's row count up to the shared bucket
+    ladder (see ``utils.bucketing``) before dispatch, so steady-state mixed
+    request sizes hit at most one compiled executable per bucket instead of
+    one per distinct coalesced size. Defaults to the DL4J_TPU_BUCKETING env
+    switch. Padded rows are zeros (inference is row-independent) and are
+    sliced off before results fan back out to requesters.
     """
 
     def __init__(self, model, mode: str = "batched", max_batch_size: int = 32,
-                 queue_limit: int = 64, worker: bool = True):
+                 queue_limit: int = 64, worker: bool = True,
+                 bucket: Optional[bool] = None):
         self.model = model
         self.mode = mode
         self.max_batch_size = max_batch_size
+        self.bucket = bucketing.bucketing_enabled() if bucket is None else bucket
         self._queue: "queue.Queue[_Pending]" = queue.Queue(maxsize=queue_limit)
+        self._carry: Optional[_Pending] = None  # request deferred by _drain
         self._stop = threading.Event()
         self._lifecycle_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
@@ -72,7 +85,13 @@ class ParallelInference:
             self._queue.put(_Pending(None))  # wake the worker
             self._thread.join(timeout=5)
             with self._lifecycle_lock:
-                # fail requests stranded in the queue so waiters don't hang
+                # fail requests stranded in the queue (or carried by the
+                # worker's coalescer) so waiters don't hang
+                if self._carry is not None:
+                    p, self._carry = self._carry, None
+                    if p.x is not None:
+                        p.result = RuntimeError("ParallelInference shut down")
+                        p.event.set()
                 while True:
                     try:
                         p = self._queue.get_nowait()
@@ -84,12 +103,27 @@ class ParallelInference:
 
     # -- worker ------------------------------------------------------------
     def _drain(self) -> List[_Pending]:
-        batch = [self._queue.get()]
-        while len(batch) < self.max_batch_size:
+        """Assemble one device batch: coalesce queued requests until the
+        EXAMPLE count reaches ``max_batch_size`` (an oversized single request
+        still goes through whole). A request that would overflow the cap is
+        carried to the next batch, so the coalesced size — and hence the set
+        of shape buckets a serving process can ever compile — is bounded."""
+        if self._carry is not None:
+            batch, self._carry = [self._carry], None
+        else:
+            batch = [self._queue.get()]
+        n = len(batch[0].x) if batch[0].x is not None else 0
+        while n < self.max_batch_size:
             try:
-                batch.append(self._queue.get_nowait())
+                p = self._queue.get_nowait()
             except queue.Empty:
                 break
+            if p.x is not None and n + len(p.x) > self.max_batch_size:
+                self._carry = p
+                break
+            batch.append(p)
+            if p.x is not None:
+                n += len(p.x)
         return [p for p in batch if p.x is not None]
 
     def _worker_loop(self):
@@ -100,7 +134,14 @@ class ParallelInference:
             try:
                 sizes = [len(p.x) for p in batch]
                 xs = np.concatenate([p.x for p in batch], axis=0)
-                out = np.asarray(self.model.output(xs))
+                total = len(xs)
+                if self.bucket and total > 0:
+                    target = bucketing.bucket_size(total)
+                    bucketing.telemetry().record_hit("pi.batched", total, target)
+                    if target > total:
+                        xs = np.concatenate(
+                            [xs, np.zeros((target - total,) + xs.shape[1:], xs.dtype)])
+                out = np.asarray(self.model.output(xs))[:total]
                 ofs = 0
                 for p, n in zip(batch, sizes):
                     p.result = out[ofs : ofs + n]

@@ -25,19 +25,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.nn.model import _iter_batches
 from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+from deeplearning4j_tpu.utils.bucketing import padded_label_mask, tile_pad
 
-
-def _tile_pad(a, pad: int):
-    """Append ``pad`` rows to ``a`` by tiling its real rows (zero rows when
-    the array is empty — a host contributing 0 examples still ships
-    correctly-shaped, zero-weighted shards)."""
-    if a is None:
-        return None
-    a = np.asarray(a)
-    if len(a) == 0:
-        return np.zeros((pad,) + a.shape[1:], a.dtype)
-    reps = np.concatenate([a] * (pad // len(a) + 1))[:pad]
-    return np.concatenate([a, reps])
+# DP sharding and shape bucketing share one padding mechanism (tiled rows +
+# zero-weighted loss); the canonical implementation lives in utils.bucketing.
+# Kept as a module name here for compatibility with existing callers.
+_tile_pad = tile_pad
 
 
 class ParallelWrapper:
@@ -130,35 +123,10 @@ class ParallelWrapper:
     def _padded_lmask(self, y, lm, n, scale=None):
         """Label mask zero-weighting padded rows [n:] so the jitted step's
         loss averages over the n REAL examples only (exact equivalence with
-        the unpadded single-device fit).
-
-        ``average_score`` keeps reference parity for per-example masks
-        (divide by the full minibatch size B, BaseOutputLayer.computeScore
-        semantics), so a 0/1 validity mask alone would yield sum_real/B_pad
-        instead of sum_real/n. The validity mask is therefore PRE-SCALED by
-        B_pad/n: the per-example branch then gives
-        sum(scores·mask)·(B_pad/n)/B_pad = sum_real/n exactly, and the
-        rank-3 sum/sum(mask) branch is scale-invariant so it stays exact.
-
-        Mask shape follows the label rank's masking convention: a user mask
-        is multiplied by the scaled row validity; absent one, rank-2/3
-        labels get a per-example [B] weight (a [B,T] mask would flip
-        average_score into its per-timestep sum/sum(mask) branch and
-        rescale gradients by 1/T), and rank-4 (CnnLossLayer) labels get the
-        per-pixel [B,H,W] mask its score() flattens (the flattened
-        denominator B_pad·H·W needs the same B_pad/n correction)."""
-        y = np.asarray(y)
-        total = len(y)
-        if scale is None and total == n and lm is None:
-            return lm
-        valid = np.zeros(total, np.float32)
-        valid[:n] = float(total) / float(n) if scale is None else float(scale)
-        if lm is not None:
-            lm = np.asarray(lm, np.float32)
-            return lm * valid.reshape([total] + [1] * (lm.ndim - 1))
-        if y.ndim == 4:
-            return np.broadcast_to(valid[:, None, None], y.shape[:3]).copy()
-        return valid
+        the unpadded single-device fit). Canonical implementation — and the
+        full derivation of the B_pad/n pre-scaling against average_score's
+        branches — lives in utils.bucketing.padded_label_mask."""
+        return padded_label_mask(y, lm, n, scale=scale)
 
     def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None):
         """Data-parallel fit: identical semantics to ``model.fit`` on a batch

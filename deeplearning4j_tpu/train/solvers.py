@@ -20,6 +20,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.flatten_util import ravel_pytree
 
+from deeplearning4j_tpu.utils import bucketing
+
 
 class BackTrackLineSearch:
     """Armijo backtracking (BackTrackLineSearch.java): shrink the step until
@@ -81,6 +83,8 @@ class Solver:
             rngs = None  # deterministic objective: no dropout/noise streams
 
             def loss_flat(flat, state, xb, yb, fmb, lmb):
+                # python body runs once per trace → counts actual compiles
+                bucketing.telemetry().record_trace("solver", np.shape(xb))
                 params = unravel(flat)
                 loss, _ = model._loss(params, state, xb, yb, fmb, lmb, rngs,
                                       train=False)
@@ -101,6 +105,25 @@ class Solver:
         from deeplearning4j_tpu.nn.model import _cast_input, _cast_labels
 
         x, y, fm, lm = _as_batch(data)
+        n = len(x)
+        if bucketing.bucketing_enabled() and n > 0 and y is not None:
+            # pad to the shared ladder so successive batches of nearby sizes
+            # reuse one value_and_grad executable per bucket. The objective
+            # is train=False (BN on running stats), so tiled pad rows only
+            # need zero loss weight: the pre-scaled validity mask keeps the
+            # loss the exact mean over the n real rows, and masked rows'
+            # gradients vanish with their scores.
+            target = bucketing.bucket_size(n)
+            bucketing.telemetry().record_hit("solver", n, target)
+            pad = target - n
+            if pad:
+                x = bucketing.tile_pad(x, pad)
+                y = bucketing.tile_pad(y, pad)
+                fm = bucketing.tile_pad(fm, pad)
+                lm = bucketing.tile_pad(lm, pad) if lm is not None else None
+            # uniform convention: the mask is always materialized, so full
+            # and padded batches share one executable per bucket
+            lm = bucketing.padded_label_mask(y, lm, n, force=True)
         x = _cast_input(x, self.model.dtype)
         y = _cast_labels(y, self.model.dtype)
         flat, unravel = self._build(x, y, fm, lm)

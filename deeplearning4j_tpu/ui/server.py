@@ -269,22 +269,29 @@ class UIServer:
         place of the reference's JVM/GC telemetry, peak host RSS from the
         OS (ru_maxrss: a lifetime high-water mark, kilobytes on Linux and
         bytes on BSD/macOS)."""
-        import resource
         import sys as _sys
 
         import jax as _jax
 
         msg = lambda k: _msg(k, lang)
         devs = _jax.devices()
-        maxrss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
-        if _sys.platform == "darwin":
-            maxrss //= 1024                    # bytes -> KB
+        try:
+            # POSIX-only; on other hosts the page renders with RSS as n/a
+            # instead of the whole endpoint 500ing
+            import resource
+
+            maxrss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            if _sys.platform == "darwin":
+                maxrss //= 1024                # bytes -> KB
+            peak_rss = f"{maxrss / 1024:.1f} MB"
+        except (ImportError, OSError):
+            peak_rss = "n/a"
         rows = {
             "backend": _jax.default_backend(),
             "devices": ", ".join(str(d) for d in devs),
             "device count": len(devs),
             "process count": _jax.process_count(),
-            "peak host RSS": f"{maxrss / 1024:.1f} MB",
+            "peak host RSS": peak_rss,
         }
         parts = [f"<html><head><meta charset='utf-8'><style>{_CSS}</style>"
                  f"<title>{html.escape(msg('train.pagetitle'))}</title>"
