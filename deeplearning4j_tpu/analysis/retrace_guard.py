@@ -1,0 +1,194 @@
+"""Runtime retrace guard: compile counts must match the bucket ladder.
+
+Static analysis (``rules.py``) proves the code can't easily regress into
+per-call retraces; this module proves the *process* didn't. The bucketing
+telemetry (``utils/bucketing.py``) already counts actual traces — jitted
+bodies call ``record_trace`` which runs once per compile — and bucket hits
+per dispatch. The ladder therefore predicts an upper bound: a jitted entry
+point should compile **at most once per distinct bucket its traffic used**.
+More compiles than buckets means something varied beyond the leading dim —
+an unpadded shape, a non-hashable static argument, a fresh jit wrapper.
+
+Checks are opt-in (telemetry is process-global, so unrelated models sharing
+a site would trip false alarms in ordinary runs):
+
+- ``DL4J_TPU_RETRACE_GUARD=1``  enable checks; violations warn once per site
+- ``DL4J_TPU_STRICT_RETRACE=1`` enable checks; violations raise RetraceError
+
+``nn.model``/``nn.graph`` call ``check_if_enabled(...)`` after each jitted
+dispatch; ``RetraceGuard`` wraps standalone functions with jit + telemetry +
+the same bound check. Nothing here imports jax at module import time.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Sequence, Set, Tuple
+
+from deeplearning4j_tpu.utils import bucketing
+
+__all__ = [
+    "GuardReport",
+    "RetraceError",
+    "RetraceGuard",
+    "RetraceWarning",
+    "check",
+    "check_if_enabled",
+    "enabled",
+    "predicted_compiles",
+    "reset_warnings",
+    "strict",
+]
+
+
+class RetraceError(RuntimeError):
+    """A jitted site compiled more often than its bucket ladder predicts."""
+
+
+class RetraceWarning(UserWarning):
+    """Non-strict flavor of :class:`RetraceError`."""
+
+
+def strict() -> bool:
+    return os.environ.get("DL4J_TPU_STRICT_RETRACE", "0") != "0"
+
+
+def enabled() -> bool:
+    return strict() or os.environ.get("DL4J_TPU_RETRACE_GUARD", "0") != "0"
+
+
+@dataclass(frozen=True)
+class GuardReport:
+    """Outcome of one bound check. ``predicted`` is None when the site has
+    no recorded bucket traffic yet (nothing to bound against)."""
+
+    site: str
+    compiles: int
+    predicted: Optional[int]
+    ok: bool
+
+
+# one warning per site per process; tests reset between cases
+_warned: Set[str] = set()
+_warned_lock = threading.Lock()
+
+
+def reset_warnings() -> None:
+    with _warned_lock:
+        _warned.clear()
+
+
+def predicted_compiles(site: str, hits_site: Optional[str] = None) -> Optional[int]:
+    """Ladder-predicted compile bound for ``site``: the number of distinct
+    buckets its traffic hit. Trace and hit counters may live under different
+    site names (e.g. traces at ``mln.step``, hits at ``mln.fit``) —
+    ``hits_site`` names the hit counter when they differ."""
+    used = bucketing.telemetry().buckets_used(hits_site or site)
+    return len(used) if used else None
+
+
+def check(site: str, hits_site: Optional[str] = None,
+          extra_allowed: int = 0) -> GuardReport:
+    """Compare observed compiles at ``site`` against the ladder bound.
+    Violations raise :class:`RetraceError` under ``DL4J_TPU_STRICT_RETRACE=1``
+    and otherwise emit one :class:`RetraceWarning` per site."""
+    tel = bucketing.telemetry()
+    compiles = tel.compiles(site)
+    predicted = predicted_compiles(site, hits_site)
+    ok = predicted is None or compiles <= predicted + extra_allowed
+    report = GuardReport(site, compiles, predicted, ok)
+    if not ok:
+        buckets = tel.buckets_used(hits_site or site)
+        msg = (
+            f"retrace guard: site '{site}' compiled {compiles}x but its "
+            f"traffic used only {predicted} bucket(s) {list(buckets)}"
+            + (f" (+{extra_allowed} allowed)" if extra_allowed else "")
+            + " — something retraces beyond the bucket ladder (unpadded "
+            "shape, non-hashable static arg, or a fresh jit wrapper per call)"
+        )
+        if strict():
+            raise RetraceError(msg)
+        with _warned_lock:
+            first = site not in _warned
+            _warned.add(site)
+        if first:
+            warnings.warn(msg, RetraceWarning, stacklevel=2)
+    return report
+
+
+def check_if_enabled(site: str, hits_site: Optional[str] = None,
+                     extra_allowed: int = 0) -> Optional[GuardReport]:
+    """No-op unless the guard env knobs are set — the hook jitted dispatch
+    paths call unconditionally."""
+    if not enabled():
+        return None
+    return check(site, hits_site, extra_allowed=extra_allowed)
+
+
+def _leading_dim(args: Sequence[Any], skip: Tuple[int, ...]) -> Optional[int]:
+    for i, a in enumerate(args):
+        if i in skip:
+            continue
+        shape = getattr(a, "shape", None)
+        if shape is not None and len(shape) >= 1:
+            return int(shape[0])
+    return None
+
+
+class RetraceGuard:
+    """jit + telemetry + bound check for a standalone function.
+
+    ``RetraceGuard(fn, site)`` behaves like ``jax.jit(fn)`` except that every
+    compile records a trace event and every call records a bucket hit (by the
+    first non-static argument's leading dim, rounded up the ladder), then the
+    compile count is checked against the ladder bound via
+    ``check_if_enabled``. jax is imported lazily on first call."""
+
+    def __init__(self, fn: Callable, site: str,
+                 static_argnums: Sequence[int] = (),
+                 ladder: Optional[bucketing.BucketLadder] = None,
+                 **jit_kwargs: Any):
+        self._fn = fn
+        self.site = site
+        self._static = tuple(static_argnums)
+        self._ladder = ladder
+        self._jit_kwargs = jit_kwargs
+        self._jitted: Optional[Callable] = None
+
+    def _build(self) -> Callable:
+        import jax
+
+        fn, site, static = self._fn, self.site, self._static
+
+        def traced(*args, **kwargs):
+            lead = _leading_dim(args, static)
+            bucketing.telemetry().record_trace(
+                site, (lead,) if lead is not None else ())
+            return fn(*args, **kwargs)
+
+        # the wrapper forwards the caller's literal spec verbatim
+        return jax.jit(traced, static_argnums=self._static,  # graftlint: disable=retrace-hazard
+                       **self._jit_kwargs)
+
+    def __call__(self, *args, **kwargs):
+        if self._jitted is None:
+            self._jitted = self._build()
+        n = _leading_dim(args, self._static)
+        if n is not None:
+            bucketing.telemetry().record_hit(
+                self.site, n, bucketing.bucket_size(n, self._ladder))
+        out = self._jitted(*args, **kwargs)
+        check_if_enabled(self.site)
+        return out
+
+    @property
+    def report(self) -> GuardReport:
+        """Current bound check without warning/raising."""
+        tel = bucketing.telemetry()
+        compiles = tel.compiles(self.site)
+        predicted = predicted_compiles(self.site)
+        ok = predicted is None or compiles <= predicted
+        return GuardReport(self.site, compiles, predicted, ok)

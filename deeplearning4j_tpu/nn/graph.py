@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.analysis import retrace_guard
 from deeplearning4j_tpu.nn.config import LayerConfig, layer_from_dict, _encode_value
 from deeplearning4j_tpu.nn.input_type import InputType
 from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrent
@@ -1039,11 +1040,14 @@ class ComputationGraph:
 
             def batches():
                 for f, l, fm, lm in self._iter_multi(source, batch_size):
+                    # real-row count taken HERE, before padding, so the fit
+                    # loop never syncs ew back from device to learn it
+                    n = len(f[0])
                     if pad_target is not None:
                         yield bucketing.pad_fit_multi(
-                            f, l, fm, lm, pad_target, site="cg.fit")
+                            f, l, fm, lm, pad_target, site="cg.fit") + (n,)
                     else:
-                        yield (f, l, fm, lm, None)
+                        yield (f, l, fm, lm, None, n)
 
             stream = batches()
             from deeplearning4j_tpu.nn.model import (
@@ -1054,7 +1058,7 @@ class ComputationGraph:
                 from deeplearning4j_tpu.datasets.iterator import prefetch_to_device
 
                 stream = prefetch_to_device(stream)
-            for f, l, fm, lm, ew in stream:
+            for f, l, fm, lm, ew, n_real in stream:
                 batch = (f, l, fm, lm)
                 chainable = (
                     chain_k > 1 and fm is None and lm is None
@@ -1073,11 +1077,10 @@ class ComputationGraph:
                 else:
                     score = self.fit_batch(batch, ew=ew)
                 if self.listeners:
-                    score = float(score)
-                    bs = (len(jax.tree_util.tree_leaves(batch[0])[0])
-                          if ew is None else int(np.asarray(ew).sum()))
+                    # n_real came from the pre-padding host side of the stream
+                    score = float(score)  # graftlint: disable=host-sync
                     for l in self.listeners:
-                        l.iteration_done(self, self.iteration, score, bs)
+                        l.iteration_done(self, self.iteration, score, n_real)
             flush(False)
             for l in self.listeners:
                 l.on_epoch_end(self, self.epoch)
@@ -1161,6 +1164,9 @@ class ComputationGraph:
             ex_weight=jnp.asarray(ew, self.dtype) if ew is not None else None,
         )
         self.iteration += 1
+        # traces land at cg.step (inside the jitted body); bucket traffic
+        # lands at cg.fit (pad_fit_multi) — the guard joins the two
+        retrace_guard.check_if_enabled("cg.step", hits_site="cg.fit")
         return loss
 
     def _fit_tbptt(self, f, l, fm, lm):
@@ -1259,9 +1265,11 @@ class ComputationGraph:
                                        self._input_dict(feats),
                                        self._mask_dict(fm))
                 outs = tuple(bucketing.unpad(o, n) for o in outs)
+                retrace_guard.check_if_enabled("cg.output")
                 return outs[0] if len(outs) == 1 else outs
         outs = self._output_fn(self.params, self.state, self._input_dict(feats),
                                self._mask_dict(fm))
+        retrace_guard.check_if_enabled("cg.output")
         return outs[0] if len(outs) == 1 else outs
 
     # -- streaming RNN inference (ComputationGraph.rnnTimeStep:2718) -------

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.analysis import retrace_guard
 from deeplearning4j_tpu.nn.config import LayerConfig, layer_from_dict, _encode_value
 from deeplearning4j_tpu.utils import bucketing
 from deeplearning4j_tpu.nn.input_type import InputType
@@ -574,11 +575,14 @@ class MultiLayerNetwork:
 
             def batches():
                 for x, y, fm, lm in _iter_batches(source, batch_size):
+                    # real-row count taken HERE, before padding, so the fit
+                    # loop never has to sync ew back from device to learn it
+                    n = len(x)
                     if pad_target is not None and not (tbptt and np.ndim(x) == 3):
                         yield bucketing.pad_fit_batch(
-                            x, y, fm, lm, pad_target, site="mln.fit")
+                            x, y, fm, lm, pad_target, site="mln.fit") + (n,)
                     else:
-                        yield (x, y, fm, lm, None)
+                        yield (x, y, fm, lm, None, n)
 
             stream = batches()
             if sgd and _device_prefetch_enabled():
@@ -587,7 +591,7 @@ class MultiLayerNetwork:
                 from deeplearning4j_tpu.datasets.iterator import prefetch_to_device
 
                 stream = prefetch_to_device(stream)
-            for x, y, fm, lm, ew in stream:
+            for x, y, fm, lm, ew, n_real in stream:
                 chainable = (
                     chain_k > 1 and fm is None and lm is None
                     and not (tbptt and np.ndim(x) == 3)
@@ -607,11 +611,10 @@ class MultiLayerNetwork:
                 else:
                     score = self._fit_batch(x, y, fm, lm, ew=ew)
                 # score is a device scalar; only sync the host when a
-                # listener actually consumes it (keeps dispatch async)
+                # listener actually consumes it (keeps dispatch async);
+                # n_real came from the pre-padding host side of the stream
                 if self.listeners:
-                    score = float(score)
-                    n_real = (len(x) if ew is None
-                              else int(np.asarray(ew).sum()))
+                    score = float(score)  # graftlint: disable=host-sync
                     for l in self.listeners:
                         l.iteration_done(self, self.iteration, score, n_real)
             flush(False)
@@ -637,6 +640,9 @@ class MultiLayerNetwork:
             ex_weight=jnp.asarray(ew, self.dtype) if ew is not None else None,
         )
         self.iteration += 1
+        # traces land at mln.step (inside the jitted body); bucket traffic
+        # lands at mln.fit (pad_fit_batch) — the guard joins the two
+        retrace_guard.check_if_enabled("mln.step", hits_site="mln.fit")
         return loss
 
     def _fit_solver(self, x, y, fm, lm):
@@ -716,12 +722,19 @@ class MultiLayerNetwork:
             if target > n:
                 x = bucketing.pad_rows_zero(x, target)
                 fmask = bucketing.pad_rows_zero(fmask, target)
-                return bucketing.unpad(
+                out = bucketing.unpad(
                     self._output_fn(self.params, self.state, x, fmask), n)
-        return self._output_fn(self.params, self.state, x, fmask)
+                retrace_guard.check_if_enabled("mln.output")
+                return out
+        out = self._output_fn(self.params, self.state, x, fmask)
+        retrace_guard.check_if_enabled("mln.output")
+        return out
 
     def predict(self, x) -> np.ndarray:
-        return np.asarray(self.output(x)).argmax(axis=-1)
+        # argmax on device: transfer the [B] class indices, not the full
+        # [B, C] activation matrix
+        idx = jnp.argmax(self.output(x), axis=-1)
+        return np.asarray(idx)  # graftlint: disable=host-sync
 
     def score(self, batch_or_x, y=None, fmask=None, lmask=None) -> float:
         """Average loss on a batch (MultiLayerNetwork.score)."""

@@ -212,9 +212,11 @@ class RemoteStatsStorageRouter(StatsStorage):
                         timestamp=record.get("timestamp", time.time())))
 
     def flush(self, timeout: float = 5.0) -> bool:
-        """Block until the buffer drains (or timeout); True if drained."""
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        """Block until the buffer drains (or timeout); True if drained.
+        Deadline is monotonic — wall-clock jumps (NTP, DST) must not hang
+        or cut short the wait."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             self._wake.set()
             if self._idle.wait(timeout=0.05) and self.pending_count() == 0:
                 return True

@@ -1,0 +1,36 @@
+"""graftlint fixture: host-sync true positives in the jit dispatch path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def fwd(params, x):
+    return jnp.dot(x, params)
+
+
+_jit_fwd = jax.jit(fwd)
+
+
+def serve(params, x):
+    out = _jit_fwd(params, x)
+    return np.asarray(out)          # BAD: pulls the result back to host
+
+
+def serve_scalar(params, x):
+    out = _jit_fwd(params, x)
+    return float(out.sum())         # BAD: blocks on the executable
+
+
+def serve_item(params, x):
+    return _jit_fwd(params, x).item()   # BAD: sync per call
+
+
+def serve_get(params, x):
+    out = _jit_fwd(params, x)
+    return jax.device_get(out)      # BAD: explicit blocking transfer
+
+
+def serve_suppressed(params, x):
+    out = _jit_fwd(params, x)
+    return np.asarray(out)  # graftlint: disable=host-sync

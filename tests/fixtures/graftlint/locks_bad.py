@@ -1,0 +1,23 @@
+"""graftlint fixture: lock-discipline true positives."""
+
+import threading
+
+_CACHE = {}
+_LOCK = threading.Lock()
+
+
+def put_unlocked(k, v):
+    _CACHE[k] = v                   # BAD: no lock held
+
+
+def pop_unlocked(k):
+    return _CACHE.pop(k, None)      # BAD: mutator without the lock
+
+
+def put_locked(k, v):
+    with _LOCK:
+        _CACHE[k] = v               # good: mutation under the lock
+
+
+def put_suppressed(k, v):
+    _CACHE[k] = v  # graftlint: disable=lock-discipline

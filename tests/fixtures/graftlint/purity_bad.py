@@ -1,0 +1,26 @@
+"""graftlint fixture: jit-purity true positives."""
+
+import time
+
+import jax
+import numpy as np
+
+_CALLS = []
+
+
+def noisy_step(x):
+    t = time.time()                 # BAD: baked in at trace time
+    r = np.random.rand()            # BAD: host RNG frozen into the trace
+    _CALLS.append(1)                # BAD: side effect runs once per trace
+    return x * r + t
+
+
+_jit_noisy = jax.jit(noisy_step)
+
+
+def quiet_step(x):
+    t = time.time()  # graftlint: disable=jit-purity
+    return x + t
+
+
+_jit_quiet = jax.jit(quiet_step)

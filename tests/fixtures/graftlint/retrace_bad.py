@@ -1,0 +1,42 @@
+"""graftlint fixture: retrace-hazard true positives."""
+
+import jax
+import jax.numpy as jnp
+
+
+def f(x):
+    return x * 2
+
+
+def train(batches):
+    for b in batches:
+        step = jax.jit(f)           # BAD: fresh jit wrapper per iteration
+        step(b)
+
+
+STATIC_SPEC = [0]
+
+
+def build():
+    # BAD: static spec is not a literal int/str tuple
+    return jax.jit(f, static_argnums=STATIC_SPEC)
+
+
+def call_fresh(x):
+    return jax.jit(f)(x)            # BAD: wrapper constructed and discarded
+
+
+_SCALE = {"v": 2.0}
+
+
+def scaled(x):
+    return x * _SCALE["v"]          # BAD: traced closure over mutable state
+
+
+_jit_scaled = jax.jit(scaled)
+
+
+def suppressed_loop(batches):
+    for b in batches:
+        step = jax.jit(f)  # graftlint: disable=retrace-hazard
+        step(b)

@@ -1,0 +1,27 @@
+"""graftlint fixture: numpy-on-tracer true positives."""
+
+import jax
+import numpy as np
+
+
+def bad_norm(x):
+    total = np.sum(x)               # BAD: np op on a tracer
+    return x / total
+
+
+_jit_bad = jax.jit(bad_norm)
+
+
+def ok_shape(x):
+    b = np.shape(x)[0]              # metadata only — allowed
+    return x * b
+
+
+_jit_ok = jax.jit(ok_shape)
+
+
+def suppressed(x):
+    return np.sum(x)  # graftlint: disable=numpy-on-tracer
+
+
+_jit_sup = jax.jit(suppressed)

@@ -1,0 +1,258 @@
+"""graftlint: per-rule true positives on fixtures, suppressions, baseline
+workflow, full-package-clean, and the runtime retrace guard (ISSUE 2)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.analysis import lint as lint_mod
+from deeplearning4j_tpu.analysis import retrace_guard
+from deeplearning4j_tpu.analysis import rules as rules_mod
+from deeplearning4j_tpu.analysis.engine import Index
+from deeplearning4j_tpu.utils import bucketing
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "fixtures", "graftlint")
+PACKAGE = os.path.join(os.path.dirname(HERE), "deeplearning4j_tpu")
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for var in ("DL4J_TPU_BUCKETING", "DL4J_TPU_BUCKETS",
+                "DL4J_TPU_BUCKET_MIN", "DL4J_TPU_BUCKET_GROWTH",
+                "DL4J_TPU_DEVICE_PREFETCH", "DL4J_TPU_RETRACE_GUARD",
+                "DL4J_TPU_STRICT_RETRACE"):
+        monkeypatch.delenv(var, raising=False)
+    bucketing.telemetry().reset()
+    retrace_guard.reset_warnings()
+    yield
+
+
+@pytest.fixture(scope="module")
+def fixture_findings():
+    return rules_mod.run(Index(FIXTURES))
+
+
+def _hits(findings, rule, filename, func):
+    return [f for f in findings
+            if f.rule == rule and f.path.endswith(filename) and f.func == func]
+
+
+# ---------------------------------------------------------------------------
+# one fixture-proven true positive per rule class
+# ---------------------------------------------------------------------------
+
+
+class TestRuleTruePositives:
+    def test_host_sync(self, fixture_findings):
+        fs = fixture_findings
+        assert _hits(fs, "host-sync", "host_sync_bad.py", "serve")
+        assert _hits(fs, "host-sync", "host_sync_bad.py", "serve_scalar")
+        assert _hits(fs, "host-sync", "host_sync_bad.py", "serve_item")
+        assert _hits(fs, "host-sync", "host_sync_bad.py", "serve_get")
+
+    def test_retrace_hazard(self, fixture_findings):
+        fs = fixture_findings
+        assert _hits(fs, "retrace-hazard", "retrace_bad.py", "train")
+        assert _hits(fs, "retrace-hazard", "retrace_bad.py", "build")
+        assert _hits(fs, "retrace-hazard", "retrace_bad.py", "call_fresh")
+        assert _hits(fs, "retrace-hazard", "retrace_bad.py", "scaled")
+
+    def test_jit_purity(self, fixture_findings):
+        fs = fixture_findings
+        msgs = " ".join(
+            f.message for f in _hits(fs, "jit-purity", "purity_bad.py",
+                                     "noisy_step"))
+        assert "time.time" in msgs
+        assert "numpy.random.rand" in msgs
+        assert "_CALLS" in msgs
+
+    def test_numpy_on_tracer(self, fixture_findings):
+        fs = fixture_findings
+        assert _hits(fs, "numpy-on-tracer", "tracer_np_bad.py", "bad_norm")
+        # metadata-only numpy stays allowed
+        assert not _hits(fs, "numpy-on-tracer", "tracer_np_bad.py", "ok_shape")
+
+    def test_lock_discipline(self, fixture_findings):
+        fs = fixture_findings
+        assert _hits(fs, "lock-discipline", "locks_bad.py", "put_unlocked")
+        assert _hits(fs, "lock-discipline", "locks_bad.py", "pop_unlocked")
+        # mutation under the lock is clean
+        assert not _hits(fs, "lock-discipline", "locks_bad.py", "put_locked")
+
+    def test_inline_suppressions(self, fixture_findings):
+        fs = fixture_findings
+        for rule, filename, func in (
+            ("host-sync", "host_sync_bad.py", "serve_suppressed"),
+            ("retrace-hazard", "retrace_bad.py", "suppressed_loop"),
+            ("jit-purity", "purity_bad.py", "quiet_step"),
+            ("numpy-on-tracer", "tracer_np_bad.py", "suppressed"),
+            ("lock-discipline", "locks_bad.py", "put_suppressed"),
+        ):
+            assert not _hits(fs, rule, filename, func), (rule, func)
+
+
+# ---------------------------------------------------------------------------
+# CLI + baseline workflow
+# ---------------------------------------------------------------------------
+
+
+class TestCli:
+    def test_fixtures_fail_without_baseline(self, capsys):
+        assert lint_mod.main([FIXTURES, "--no-baseline"]) == 1
+        out = capsys.readouterr()
+        assert "[host-sync]" in out.out
+        assert "new finding(s)" in out.err
+
+    def test_fix_baseline_then_clean(self, tmp_path, capsys):
+        bl = str(tmp_path / "baseline.json")
+        assert lint_mod.main([FIXTURES, "--baseline", bl,
+                              "--fix-baseline"]) == 0
+        data = json.load(open(bl))
+        assert data["allowed"] and all(
+            c >= 1 for c in data["allowed"].values())
+        assert lint_mod.main([FIXTURES, "--baseline", bl]) == 0
+        out = capsys.readouterr()
+        assert "clean" in out.out
+
+    def test_stale_baseline_entries_reported_not_fatal(self, tmp_path, capsys):
+        bl = tmp_path / "baseline.json"
+        lint_mod.main([FIXTURES, "--baseline", str(bl), "--fix-baseline"])
+        data = json.load(open(bl))
+        data["allowed"]["gone.py::host-sync::f::x = y"] = 1
+        bl.write_text(json.dumps(data))
+        assert lint_mod.main([FIXTURES, "--baseline", str(bl)]) == 0
+        assert "stale" in capsys.readouterr().out
+
+    def test_rule_subset_and_unknown_rule(self, capsys):
+        assert lint_mod.main([FIXTURES, "--no-baseline",
+                              "--rules", "lock-discipline"]) == 1
+        out = capsys.readouterr().out
+        assert "[lock-discipline]" in out and "[host-sync]" not in out
+        assert lint_mod.main([FIXTURES, "--rules", "no-such-rule"]) == 2
+
+    def test_missing_target(self):
+        assert lint_mod.main(["/no/such/path"]) == 2
+
+    def test_package_lints_clean_against_checked_in_baseline(self):
+        # the tier-1 CI gate: the shipped package vs the shipped baseline
+        assert lint_mod.main([PACKAGE]) == 0
+
+    def test_fingerprints_survive_line_shifts(self, tmp_path):
+        src = (
+            "import jax\nimport numpy as np\n\n"
+            "def fwd(x):\n    return x\n\n_jf = jax.jit(fwd)\n\n"
+            "def serve(x):\n    out = _jf(x)\n    return np.asarray(out)\n"
+        )
+        pkg = tmp_path / "minipkg"
+        pkg.mkdir()
+        (pkg / "m.py").write_text(src)
+        bl = str(tmp_path / "bl.json")
+        assert lint_mod.main([str(pkg), "--baseline", bl,
+                              "--fix-baseline"]) == 0
+        # shift every line down: same finding, different line number
+        (pkg / "m.py").write_text("# a comment\n# another\n" + src)
+        assert lint_mod.main([str(pkg), "--baseline", bl]) == 0
+
+
+# ---------------------------------------------------------------------------
+# runtime retrace guard
+# ---------------------------------------------------------------------------
+
+
+def _bn_model(seed=11):
+    from deeplearning4j_tpu.nn.input_type import InputType
+    from deeplearning4j_tpu.nn.layers import BatchNorm, Dense, OutputLayer
+    from deeplearning4j_tpu.nn.model import (
+        MultiLayerConfiguration, MultiLayerNetwork)
+
+    conf = MultiLayerConfiguration(
+        layers=(
+            Dense(n_out=16, activation="identity"),
+            BatchNorm(),
+            Dense(n_out=8, activation="tanh"),
+            OutputLayer(n_out=2, activation="softmax"),
+        ),
+        input_type=InputType.feed_forward(4),
+        updater={"type": "sgd", "lr": 0.1},
+        seed=seed,
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+class _FreshKey:
+    """Hashable but never equal across instances: every call with a new
+    instance is a fresh jit cache entry — a deliberate retrace."""
+
+
+class TestRetraceGuard:
+    def test_predicts_exact_compiles_on_bucket_scenario(self, monkeypatch):
+        # acceptance: the test_bucketing one-compile-per-bucket scenario —
+        # sizes 3..8 hit buckets {4, 8}; 9 and 12 hit 16: exactly 3 compiles
+        monkeypatch.setenv("DL4J_TPU_RETRACE_GUARD", "1")
+        m = _bn_model()
+        x = np.random.RandomState(0).randn(12, 4).astype(np.float32)
+        for n in (3, 4, 5, 6, 7, 8, 9, 12):
+            m.output(x[:n])
+        tel = bucketing.telemetry()
+        assert retrace_guard.predicted_compiles("mln.output") == 3
+        assert tel.compiles("mln.output") == 3
+        rep = retrace_guard.check("mln.output")
+        assert rep.ok and rep.compiles == rep.predicted == 3
+
+    def test_guard_disabled_by_default(self):
+        assert retrace_guard.check_if_enabled("mln.output") is None
+
+    def test_strict_raises_on_unhashable_static_arg(self, monkeypatch):
+        # acceptance: a static arg that hashes fresh per instance forces an
+        # extra trace beyond the single bucket the traffic used
+        monkeypatch.setenv("DL4J_TPU_STRICT_RETRACE", "1")
+        monkeypatch.setenv("DL4J_TPU_BUCKETS", "8")
+        g = retrace_guard.RetraceGuard(
+            lambda x, key: x * 2.0, "guard.static", static_argnums=(1,))
+        x = np.ones((8, 3), np.float32)
+        g(x, _FreshKey())                     # compile 1, bucket {8}: ok
+        assert g.report.ok
+        with pytest.raises(retrace_guard.RetraceError, match="guard.static"):
+            g(x, _FreshKey())                 # compile 2, still bucket {8}
+
+    def test_nonstrict_warns_once_per_site(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_RETRACE_GUARD", "1")
+        monkeypatch.setenv("DL4J_TPU_BUCKETS", "8")
+        g = retrace_guard.RetraceGuard(
+            lambda x, key: x + 1.0, "guard.warn", static_argnums=(1,))
+        x = np.ones((8, 3), np.float32)
+        g(x, _FreshKey())
+        with pytest.warns(retrace_guard.RetraceWarning, match="guard.warn"):
+            g(x, _FreshKey())
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter("error")          # second violation: warn-once
+            g(x, _FreshKey())
+        assert not g.report.ok
+
+    def test_extra_allowed_budget(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_STRICT_RETRACE", "1")
+        tel = bucketing.telemetry()
+        tel.record_hit("guard.budget", 4, 8)
+        tel.record_trace("guard.budget", (8,))
+        tel.record_trace("guard.budget", (8,))
+        assert retrace_guard.check("guard.budget", extra_allowed=1).ok is True
+        with pytest.raises(retrace_guard.RetraceError):
+            retrace_guard.check("guard.budget")
+
+    def test_fit_guard_clean_on_padded_stream(self, monkeypatch):
+        # the wired mln.step/mln.fit pairing: a padded fit (one executable,
+        # one bucket) passes the strict guard end to end
+        monkeypatch.setenv("DL4J_TPU_STRICT_RETRACE", "1")
+        monkeypatch.setenv("DL4J_TPU_CHAIN_STEPS", "0")
+        rs = np.random.RandomState(0)
+        x = rs.randn(20, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 20)]
+        m = _bn_model()
+        m.fit((x, y), epochs=2, batch_size=8)   # 20 % 8 != 0: padded tail
+        tel = bucketing.telemetry()
+        assert tel.compiles("mln.step") == 1
+        assert retrace_guard.check("mln.step", hits_site="mln.fit").ok
