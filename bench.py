@@ -44,6 +44,28 @@ import numpy as np
 # itself (tests / local runs). Real numbers come from the default config.
 SMOKE = bool(int(os.environ.get("BENCH_SMOKE", "0")))
 
+# DL4J_TPU_BENCH_BUDGET_S: per-metric wall-clock budget (seconds). Round 5's
+# lenet5 run timed out at the subprocess kill (rc=124, no JSON) because the
+# dispatch-latency microbench repeats 5 timing loops plus a chained variant
+# with no notion of elapsed time. Now every bench arms a deadline at entry:
+# _timed() shrinks its measure loop to fit the time remaining and optional
+# variants (lenet5's chained arm, extra median reps) are skipped once the
+# budget is spent — a full `python bench.py` always emits JSON for every
+# metric. 0 disables the budget.
+_BUDGET_S = float(os.environ.get("DL4J_TPU_BENCH_BUDGET_S", "120"))
+_DEADLINE: float | None = None
+
+
+def _budget_start():
+    global _DEADLINE
+    _DEADLINE = (time.perf_counter() + _BUDGET_S) if _BUDGET_S > 0 else None
+
+
+def _budget_left() -> float:
+    if _DEADLINE is None:
+        return float("inf")
+    return _DEADLINE - time.perf_counter()
+
 NOMINAL = {
     "lenet5_mnist_train_throughput": 10_000.0,
     "resnet50_224_train_throughput": 360.0,
@@ -94,10 +116,20 @@ def _mfu_from_cost(compiled, steps_per_sec: float) -> dict:
 
 
 def _timed(run, warmup_steps: int = 5, steps: int = 30):
-    """run(n) executes n steps and blocks on the result. Returns seconds."""
+    """run(n) executes n steps and blocks on the result. Returns (sec, steps).
+
+    Budget-aware: the timed warmup yields a per-step estimate, and the
+    measure loop is clamped so warmup + measure fit the bench's remaining
+    DL4J_TPU_BENCH_BUDGET_S (never below 1 step — a shrunk-but-measured
+    number beats a killed subprocess with no JSON)."""
     if SMOKE:
         warmup_steps, steps = 1, 2
+    t0 = time.perf_counter()
     run(warmup_steps)
+    per_step = (time.perf_counter() - t0) / max(warmup_steps, 1)
+    left = _budget_left()
+    if left != float("inf") and per_step > 0:
+        steps = max(1, min(steps, int(left / per_step)))
     t0 = time.perf_counter()
     run(steps)
     return time.perf_counter() - t0, steps
@@ -167,47 +199,62 @@ def bench_lenet5():
         float(loss)  # value fetch: the only sync the tunnel cannot elide
 
     # dispatch-latency-bound microbench: single draws vary with tunnel
-    # jitter, so report the median of k timing loops with the spread
+    # jitter, so report the median of k timing loops with the spread —
+    # stopping early (with at least one draw) once the budget is spent
     reps = []
     k = 1 if SMOKE else 5
     for _ in range(k):
         dt, steps = _timed(run, warmup_steps=5, steps=50)
         reps.append(steps * batch / dt)
+        if _budget_left() <= 0:
+            break
     reps.sort()
     per_step = reps[len(reps) // 2]
 
     # ROUND 5: fit()'s chained hot loop — K steps per dispatch (lax.scan
     # of the step body) amortizes the ~4 ms per-dispatch floor that
-    # dominates this small model (docs/PERF.md LeNet).
-    K = 2 if SMOKE else 10
-    chain = model._get_chain_step()
-    xs = jnp.stack([x] * K)
-    ys = jnp.stack([y] * K)
-    st2 = st  # model.params were DONATED by the per-step loop; st is live
-
-    def run_chained(n):
-        losses = None
-        for i in range(n):
-            st2[0], st2[1], st2[2], losses = chain(
-                st2[0], st2[1], st2[2], jnp.asarray(i * K, jnp.int32),
-                jax.random.PRNGKey(i), xs, ys)
-        float(losses[-1])  # value fetch
-    reps2 = []
-    for _ in range(k):
-        dt, disp = _timed(run_chained, warmup_steps=2, steps=10)
-        reps2.append(disp * K * batch / dt)
-    reps2.sort()
-    sps = reps2[len(reps2) // 2]
-    return {
+    # dominates this small model (docs/PERF.md LeNet). The chained arm
+    # costs a SECOND full compile, so it is the first thing the budget
+    # drops (round 5's rc=124: this compile + 5 more timing loops blew
+    # the 900 s subprocess kill with no JSON emitted at all).
+    out = {
         "metric": "lenet5_mnist_train_throughput",
+        "median_of": len(reps),
+        "per_step_dispatch_samples_per_sec": round(per_step, 1),
+    }
+    if _budget_left() < max(10.0, 0.2 * _BUDGET_S):
+        sps = per_step
+        out["chained_skipped"] = "bench budget exceeded (DL4J_TPU_BENCH_BUDGET_S)"
+    else:
+        K = 2 if SMOKE else 10
+        chain = model._get_chain_step()
+        xs = jnp.stack([x] * K)
+        ys = jnp.stack([y] * K)
+        st2 = st  # model.params were DONATED by the per-step loop; st is live
+
+        def run_chained(n):
+            losses = None
+            for i in range(n):
+                st2[0], st2[1], st2[2], losses = chain(
+                    st2[0], st2[1], st2[2], jnp.asarray(i * K, jnp.int32),
+                    jax.random.PRNGKey(i), xs, ys)
+            float(losses[-1])  # value fetch
+        reps2 = []
+        for _ in range(k):
+            dt, disp = _timed(run_chained, warmup_steps=2, steps=10)
+            reps2.append(disp * K * batch / dt)
+            if _budget_left() <= 0:
+                break
+        reps2.sort()
+        sps = reps2[len(reps2) // 2]
+        out["chain_steps_per_dispatch"] = K
+        out["spread_samples_per_sec"] = [round(reps2[0], 1), round(reps2[-1], 1)]
+    out.update({
         "value": round(sps, 1),
         "unit": "samples/sec",
         "vs_baseline": round(sps / NOMINAL["lenet5_mnist_train_throughput"], 3),
-        "chain_steps_per_dispatch": K,
-        "median_of": k,
-        "spread_samples_per_sec": [round(reps2[0], 1), round(reps2[-1], 1)],
-        "per_step_dispatch_samples_per_sec": round(per_step, 1),
-    }
+    })
+    return out
 
 
 def bench_resnet50():
@@ -626,6 +673,111 @@ def bench_serving_mixed():
     }
 
 
+def _cpu_mesh_env(n: int = 8) -> dict:
+    """Env forcing an n-device host-platform mesh (must be set before jax
+    initializes) — the dp_comms microbench models an R-replica exchange on
+    a single host, like tests/conftest.py's 8 virtual CPU devices."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+    return env
+
+
+def bench_dp_comms():
+    """Tentpole probe — data-parallel gradient-exchange arms on an 8-replica
+    mesh (virtual CPU devices; the ratios are static byte accounting, the
+    step times are relative sanity only on CPU):
+
+      dense      implicit XLA psum + replicated update (the default path)
+      sharded    explicit reduce-scatter -> 1/R-shard update -> all-gather
+      compressed ternary threshold encoding, replicated update
+      comp+shard both — the full DCN-lean configuration
+
+    Headline value is the gradient wire-byte reduction of comp+shard vs the
+    dense all-reduce (the ISSUE gate: >= 4x; ternary packing gives 16x
+    modulo shard padding). Param all-gather bytes are reported separately."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.input_type import InputType
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.model import (
+        MultiLayerConfiguration, MultiLayerNetwork)
+    from deeplearning4j_tpu.parallel import MeshSpec, ParallelWrapper, make_mesh
+
+    R = min(8, jax.device_count())
+    n_feat, hidden, classes, batch = 64, 512, 10, 8 * R
+    steps = 2 if SMOKE else 20
+    if SMOKE:
+        hidden = 32
+
+    def build():
+        conf = MultiLayerConfiguration(
+            layers=(Dense(n_out=hidden, activation="tanh"),
+                    OutputLayer(n_out=classes, activation="softmax")),
+            input_type=InputType.feed_forward(n_feat),
+            updater={"type": "adam", "lr": 0.01},
+            seed=7,
+        )
+        return MultiLayerNetwork(conf).init()
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(batch, n_feat).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rs.randint(0, classes, batch)]
+    mesh = make_mesh(MeshSpec(data=R))
+
+    arms = {}
+    stats = {}
+    for arm, (comp, shard) in (
+        ("dense", (False, False)),
+        ("sharded", (False, True)),
+        ("compressed", (True, False)),
+        ("compressed_sharded", (True, True)),
+    ):
+        model = build()
+        pw = ParallelWrapper(model, mesh=mesh, grad_compress=comp,
+                             sharded_update=shard, compress_threshold=1e-3)
+        pw._replicate_model()
+        xs, ys = pw._shard(x), pw._shard(y)
+        runner = pw._exchange_runner()
+        if runner is not None:
+            runner.begin()
+            step = lambda: runner.fit_batch(xs, ys, None, None)
+        else:
+            step = lambda: model._fit_batch(xs, ys, None, None)
+
+        def run(n):
+            loss = None
+            for _ in range(n):
+                loss = step()
+            float(loss)  # value fetch: the only sync the tunnel cannot elide
+
+        dt, n_done = _timed(run, warmup_steps=2, steps=steps)
+        arms[arm] = round(n_done * batch / dt, 1)
+        # dense/implicit moves every gradient once (psum payload)
+        stats[arm] = (runner.comm_stats() if runner is not None else None)
+        if runner is not None:
+            runner.finish()
+
+    full = stats["compressed_sharded"]
+    ratio = full["dense_bytes"] / max(full["wire_bytes"], 1)
+    return {
+        "metric": "dp_comms_grad_bytes_reduction",
+        "value": round(ratio, 1),
+        "unit": "x (dense grad bytes / compressed wire bytes, per step)",
+        "replicas": R,
+        "grad_dense_bytes": full["dense_bytes"],
+        "grad_wire_bytes": full["wire_bytes"],
+        "param_allgather_bytes": full["param_bytes"],
+        "arms_samples_per_sec": arms,
+        "note": ("virtual-CPU mesh: byte counts are exact (static), step "
+                 "times are relative sanity only"),
+    }
+
+
 _BENCHES = {
     "lenet5": bench_lenet5,
     "resnet50": bench_resnet50,
@@ -633,7 +785,12 @@ _BENCHES = {
     "word2vec": bench_word2vec,
     "transformer": bench_transformer,
     "serving": bench_serving_mixed,
+    "dp_comms": bench_dp_comms,
 }
+
+# benches that need a multi-device mesh regardless of the host's accelerator
+# count — run on forced virtual CPU devices in their isolated subprocess
+_CPU_MESH_BENCHES = {"dp_comms"}
 
 
 def _run_isolated(name: str) -> dict:
@@ -643,10 +800,16 @@ def _run_isolated(name: str) -> dict:
     import subprocess
     import sys
 
+    # kill-timeout derives from the per-metric budget: the budget bounds the
+    # measure loops, the headroom covers compiles — and a budget-shrunk bench
+    # exits with its JSON long before the kill lands (satellite fix for
+    # round 5's lenet5 rc=124)
+    timeout = (3 * _BUDGET_S + 300) if _BUDGET_S > 0 else 900
+    env = _cpu_mesh_env() if name in _CPU_MESH_BENCHES else None
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--only", name],
-            capture_output=True, text=True, timeout=900,
+            capture_output=True, text=True, timeout=timeout, env=env,
             cwd=os.path.dirname(os.path.abspath(__file__)))
     except subprocess.SubprocessError as e:  # hang/timeouts must not sink the rest
         return {"metric": name, "error": f"{type(e).__name__}: {e}"[:300]}
@@ -664,6 +827,18 @@ def _run_isolated(name: str) -> dict:
 def main():
     import argparse
 
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", choices=sorted(_BENCHES),
+                    help="run ONE benchmark in-process (internal)")
+    ap.add_argument("--in-process", action="store_true",
+                    help="run all benchmarks in this process (no isolation)")
+    args = ap.parse_args()
+
+    # mesh-needing benches launched directly (not via _run_isolated) still
+    # get their virtual devices — must land before jax initializes
+    if args.only in _CPU_MESH_BENCHES:
+        os.environ.update(_cpu_mesh_env())
+
     # DL4J_TPU_COMPILE_CACHE: persistent XLA cache (opt-in) — amortizes
     # the long-pole compiles (W2V epoch scan: 52.2s cold) across bench
     # processes; the cold/warm split stays honestly reported either way
@@ -672,14 +847,8 @@ def main():
 
     enable_compilation_cache_from_env()
 
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--only", choices=sorted(_BENCHES),
-                    help="run ONE benchmark in-process (internal)")
-    ap.add_argument("--in-process", action="store_true",
-                    help="run all benchmarks in this process (no isolation)")
-    args = ap.parse_args()
-
     if args.only:
+        _budget_start()
         try:
             print(json.dumps(_BENCHES[args.only]()), flush=True)
         except Exception as e:
@@ -690,6 +859,7 @@ def main():
     extras = []
     for name, fn in _BENCHES.items():
         if args.in_process or SMOKE:
+            _budget_start()
             try:
                 m = fn()
             except Exception as e:

@@ -852,7 +852,13 @@ class ComputationGraph:
         return jax.jit(self._make_step_body(with_carries),
                        donate_argnums=(0, 1, 2))
 
-    def _make_step_body(self, with_carries: bool = False):
+    def _make_step_body(self, with_carries: bool = False, grad_exchange=None):
+        """The pure training-step closure. ``grad_exchange`` (a
+        ``parallel.grads.GradExchange``) replaces the per-vertex update loop
+        with an explicit cross-replica exchange — same contract as
+        ``MultiLayerNetwork._step_body``: opt_state slot becomes
+        ``(opt_state, residuals)``, loss/state are replica-means, the
+        signature and return arity stay unchanged."""
         order = self.topo_order
         updaters = self._updaters
 
@@ -861,6 +867,8 @@ class ComputationGraph:
             # python body runs once per trace → counts actual compiles
             bucketing.telemetry().record_trace(
                 "cg.step", np.shape(next(iter(inputs.values()))))
+            if grad_exchange is not None:
+                opt_state, residuals = opt_state
             rngs = list(jax.random.split(rng, len(order)))
 
             def loss_fn(p):
@@ -870,6 +878,13 @@ class ComputationGraph:
 
             ((loss, (new_state, new_carries)), grads) = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
+            if grad_exchange is not None:
+                loss = grad_exchange.mean_loss(loss)
+                new_state = grad_exchange.mean_state(new_state)
+                new_params, new_opt, new_res = grad_exchange.update(
+                    grads, params, opt_state, residuals, it)
+                return (new_params, (new_opt, new_res), new_state,
+                        new_carries, loss)
             new_params, new_opt = {}, {}
             for name in order:
                 g = grads[name]

@@ -432,7 +432,13 @@ class MultiLayerNetwork:
     def _make_step(self, with_carries: bool):
         return jax.jit(self._step_body(with_carries), donate_argnums=(0, 1, 2))
 
-    def _step_body(self, with_carries: bool):
+    def _step_body(self, with_carries: bool, grad_exchange=None):
+        """The pure training-step closure. ``grad_exchange`` (a
+        ``parallel.grads.GradExchange``) replaces the per-layer update loop
+        with an explicit cross-replica exchange; the body then runs under
+        shard_map with per-replica local batches, the opt_state slot carries
+        ``(opt_state, residuals)``, and loss/state are replica-means — the
+        step's signature and return arity are unchanged."""
         updaters = self._updaters
         layers = self.layers
 
@@ -440,6 +446,8 @@ class MultiLayerNetwork:
                  ex_weight=None):
             # python body runs once per trace → counts actual compiles
             bucketing.telemetry().record_trace("mln.step", np.shape(x))
+            if grad_exchange is not None:
+                opt_state, residuals = opt_state
             rngs = list(jax.random.split(rng, len(layers)))
 
             def loss_fn(p):
@@ -450,6 +458,14 @@ class MultiLayerNetwork:
             (loss, (new_state, new_carries)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(params)
+
+            if grad_exchange is not None:
+                loss = grad_exchange.mean_loss(loss)
+                new_state = grad_exchange.mean_state(new_state)
+                new_params, new_opt, new_res = grad_exchange.update(
+                    grads, params, opt_state, residuals, it)
+                return (new_params, (new_opt, new_res), new_state,
+                        new_carries, loss)
 
             new_params = []
             new_opt = []

@@ -5,12 +5,19 @@ TPU-native replacement for the reference's entire scaleout stack
 Spark TrainingMasters, and the Aeron VoidParameterServer all collapse into
 ONE mechanism — a jitted train step whose batch is sharded over a mesh axis
 and whose gradients are all-reduced by XLA collectives over ICI (DCN across
-slices). Threshold compression (EncodedGradientsAccumulator) is deliberately
-absent: it existed because Ethernet was the bottleneck; ICI makes dense
-bf16/f32 all-reduce cheaper than encode/decode (SURVEY.md §5.8).
+slices). Threshold compression (EncodedGradientsAccumulator) and the
+cross-replica sharded weight update are available as an OPT-IN explicit
+exchange (parallel/grads.py, env DL4J_TPU_GRAD_COMPRESS /
+DL4J_TPU_SHARDED_UPDATE): on a single ICI-connected slice the implicit dense
+all-reduce is already optimal (SURVEY.md §5.8), but when the exchange
+crosses DCN — multi-slice or Ethernet-attached hosts — the 16x ternary wire
+format and the 1/R-per-replica optimizer math pay for themselves. Both
+switches default OFF; see docs/PERF.md.
 """
 
-from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+from deeplearning4j_tpu.parallel.mesh import (
+    MeshSpec, data_axis_size, data_sharded, make_mesh,
+)
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 from deeplearning4j_tpu.parallel.inference import ParallelInference
 from deeplearning4j_tpu.parallel.context import current_mesh, use_mesh
@@ -21,6 +28,16 @@ from deeplearning4j_tpu.parallel.distributed import (
     replicate_global,
     shutdown_distributed,
 )
+from deeplearning4j_tpu.parallel.compress import (
+    decode_gathered,
+    encode_packed,
+    pack_ternary,
+    packed_nbytes,
+    threshold_decode,
+    threshold_encode,
+    unpack_ternary,
+)
+from deeplearning4j_tpu.parallel.grads import DataParallelStep, GradExchange
 from deeplearning4j_tpu.parallel.gpipe import GPipeTrainer
 from deeplearning4j_tpu.parallel.ring import local_attention, ring_self_attention
 from deeplearning4j_tpu.parallel.pipeline import PipelineParallel, stack_stage_params
@@ -32,4 +49,7 @@ __all__ = [
     "GPipeTrainer", "PipelineParallel", "stack_stage_params", "ShardedTrainer",
     "tp_param_shardings", "init_distributed", "shutdown_distributed",
     "is_multihost", "global_array", "replicate_global",
+    "DataParallelStep", "GradExchange", "data_axis_size", "data_sharded",
+    "threshold_encode", "threshold_decode", "pack_ternary", "unpack_ternary",
+    "encode_packed", "decode_gathered", "packed_nbytes",
 ]

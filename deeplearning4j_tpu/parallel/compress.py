@@ -1,0 +1,116 @@
+"""Threshold gradient compression with residual error feedback.
+
+Capability parity with ND4J's ``thresholdEncode``/``thresholdDecode``
+(SURVEY §1 layer 1) — the mechanism behind the reference's
+EncodedGradientsAccumulator / SharedTrainingMaster gradient sharing —
+re-designed TPU-first: everything here is pure jax on fixed shapes, so the
+encode → exchange → decode round-trip stays INSIDE the one compiled train
+step (no host round-trip, no variable-length buffers), and the per-replica
+residual rides in the donated step carry.
+
+Scheme (1-bit / ternary quantization):
+
+- ``threshold_encode``: accumulate the incoming gradient into the residual,
+  emit ``sign(acc) * threshold`` wherever ``|acc| >= threshold`` and carry
+  the remainder forward. The residual error feedback makes the scheme
+  lossless over time: every gradient component is eventually transmitted
+  (``sum(q_t) + r_T == sum(g_t) + r_0`` holds exactly as an algebraic
+  invariant).
+- ``pack_ternary`` / ``unpack_ternary``: 2 bits per element (codes 0/+1/-1
+  packed 4-per-byte), a 16x wire-size reduction vs float32 gradients. The
+  packed uint8 array is what crosses the interconnect (all-gather over the
+  ``data`` axis — compressed payloads are not summable, so replicas exchange
+  encodings and every replica decodes + sums deterministically, exactly like
+  the reference's workers applying each other's encoded updates).
+
+Everything is bitwise-deterministic: elementwise ops plus a fixed-order sum
+over the replica axis, so identically-seeded runs produce identical params.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+__all__ = [
+    "decode_gathered",
+    "encode_packed",
+    "pack_ternary",
+    "packed_nbytes",
+    "threshold_decode",
+    "threshold_encode",
+    "unpack_ternary",
+]
+
+# 2 bits per element, 4 elements per packed byte.
+_ELEMS_PER_BYTE = 4
+
+
+def packed_nbytes(n: int) -> int:
+    """Wire bytes for an ``n``-element ternary-packed gradient."""
+    return (n + _ELEMS_PER_BYTE - 1) // _ELEMS_PER_BYTE
+
+
+def threshold_encode(grad, residual, threshold) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Quantize ``grad + residual`` to {-threshold, 0, +threshold}.
+
+    Returns ``(q, new_residual)`` with ``q + new_residual == grad + residual``
+    exactly — the error-feedback invariant that makes repeated encoding
+    lossless over time (components below threshold accumulate until they
+    cross it).
+    """
+    acc = grad + residual
+    thr = jnp.asarray(threshold, acc.dtype)
+    q = jnp.where(jnp.abs(acc) >= thr, jnp.sign(acc) * thr,
+                  jnp.zeros_like(acc))
+    return q, acc - q
+
+
+def threshold_decode(q, target):
+    """Apply an encoded update to ``target`` (ND4J thresholdDecode parity:
+    decode accumulates the quantized update into the receiver's buffer)."""
+    return target + q
+
+
+def pack_ternary(signs) -> jnp.ndarray:
+    """Pack a 1-D array of {-1, 0, +1} values into 2-bit codes, 4 per byte.
+
+    Code map: 0 -> 0, +1 -> 1, -1 -> 2 (code 3 unused). Returns uint8 of
+    ``packed_nbytes(n)`` bytes; trailing slots in the last byte are 0.
+    """
+    n = signs.shape[0]
+    codes = ((signs > 0).astype(jnp.int32) + 2 * (signs < 0).astype(jnp.int32))
+    pad = (-n) % _ELEMS_PER_BYTE
+    if pad:
+        codes = jnp.concatenate([codes, jnp.zeros((pad,), jnp.int32)])
+    codes = codes.reshape(-1, _ELEMS_PER_BYTE)
+    weights = jnp.asarray([1, 4, 16, 64], jnp.int32)
+    return jnp.sum(codes * weights, axis=1).astype(jnp.uint8)
+
+
+def unpack_ternary(packed, n: int) -> jnp.ndarray:
+    """Inverse of :func:`pack_ternary`; accepts a leading batch axis (the
+    all-gathered ``[R, nbytes]`` payload) and returns float32 signs
+    ``[..., n]`` in {-1, 0, +1}."""
+    b = packed.astype(jnp.int32)
+    codes = jnp.stack([(b >> s) & 3 for s in (0, 2, 4, 6)], axis=-1)
+    flat = codes.reshape(packed.shape[:-1] + (-1,))[..., :n]
+    return (flat == 1).astype(jnp.float32) - (flat == 2).astype(jnp.float32)
+
+
+def encode_packed(grad, residual, threshold):
+    """One replica's wire payload: ``(packed_uint8, new_residual)``."""
+    q, new_residual = threshold_encode(grad, residual, threshold)
+    return pack_ternary(jnp.sign(q)), new_residual
+
+
+def decode_gathered(gathered, n: int, threshold, dtype):
+    """Decode the all-gathered ``[R, nbytes]`` payloads and sum over replicas.
+
+    The sum runs in float32 in a fixed order (axis 0), then casts to the
+    gradient dtype — deterministic on every backend.
+    """
+    signs = unpack_ternary(gathered, n)               # [R, n] float32
+    total = signs.sum(axis=0) * jnp.asarray(threshold, jnp.float32)
+    return total.astype(dtype)

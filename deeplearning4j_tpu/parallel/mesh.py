@@ -62,3 +62,15 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def batch_sharded(mesh: Mesh, ndim: int = 2) -> NamedSharding:
     """Shard axis 0 (batch) over the data axis, replicate the rest."""
     return NamedSharding(mesh, P("data", *([None] * (ndim - 1))))
+
+
+def data_axis_size(mesh: Mesh) -> int:
+    """Replica count of the data-parallel exchange (the ``data`` axis)."""
+    return mesh.shape["data"]
+
+
+def data_sharded(mesh: Mesh) -> NamedSharding:
+    """Shard axis 0 over ``data`` with no constraint on trailing axes — the
+    layout of the explicit-exchange opt state (``[R, m]`` flat-shard stacks)
+    and compression residuals (``[R, n_pad]``) in ``parallel/grads.py``."""
+    return NamedSharding(mesh, P("data"))

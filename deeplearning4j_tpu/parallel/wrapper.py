@@ -16,6 +16,8 @@ overlapped with backprop by the compiler.
 
 from __future__ import annotations
 
+import os
+import warnings
 from typing import Optional
 
 import jax
@@ -25,7 +27,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.nn.model import _iter_batches
 from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+from deeplearning4j_tpu.utils import bucketing
 from deeplearning4j_tpu.utils.bucketing import padded_label_mask, tile_pad
+
+
+def _env_flag(name: str) -> Optional[bool]:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return None
+    return raw != "0"
 
 # DP sharding and shape bucketing share one padding mechanism (tiled rows +
 # zero-weighted loss); the canonical implementation lives in utils.bucketing.
@@ -46,11 +56,30 @@ class ParallelWrapper:
     round-robins whole DataSets to workers; here the sharding is exact).
     """
 
-    def __init__(self, model, mesh: Optional[Mesh] = None):
+    def __init__(self, model, mesh: Optional[Mesh] = None,
+                 grad_compress: Optional[bool] = None,
+                 sharded_update: Optional[bool] = None,
+                 compress_threshold: Optional[float] = None):
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh(MeshSpec())
         self.n_data = self.mesh.shape["data"]
         self._repl = NamedSharding(self.mesh, P())
+        # Explicit-exchange switches (parallel/grads.py): kwargs win, then
+        # env (DL4J_TPU_GRAD_COMPRESS / DL4J_TPU_SHARDED_UPDATE /
+        # DL4J_TPU_COMPRESS_THRESHOLD), default OFF — on a single
+        # ICI-connected slice the implicit dense psum is already optimal;
+        # see docs/PERF.md "Compressed collectives & sharded weight updates".
+        if grad_compress is None:
+            grad_compress = _env_flag("DL4J_TPU_GRAD_COMPRESS")
+        if sharded_update is None:
+            sharded_update = _env_flag("DL4J_TPU_SHARDED_UPDATE")
+        if compress_threshold is None:
+            compress_threshold = float(
+                os.environ.get("DL4J_TPU_COMPRESS_THRESHOLD", "1e-3"))
+        self.grad_compress = bool(grad_compress)
+        self.sharded_update = bool(sharded_update)
+        self.compress_threshold = float(compress_threshold)
+        self._runner = None
         # Multi-host (jax.distributed): every process runs this same fit()
         # on its process-LOCAL batch rows; global batch = concat over
         # processes in process order. Per-host batch sizes may be UNEVEN
@@ -83,20 +112,29 @@ class ParallelWrapper:
         if self.model.opt_state is not None:
             self.model.opt_state = replicate_global(self.mesh, self.model.opt_state)
 
-    def _pad_to_shardable(self, arrs):
-        """Tile members of a batch so the leading axis divides n_data.
+    def _pad_to_shardable(self, arrs, record: bool = False):
+        """Tile members of a batch so the leading axis divides n_data —
+        rounded UP the shared bucketing ladder first (utils.bucketing), so DP
+        fit with ragged batch sizes reuses a bounded set of compiled
+        executables exactly like the single-chip path (every distinct padded
+        size is a fresh XLA compile of the sharded step). Disable via
+        DL4J_TPU_BUCKETING=0 to pad only to the shard count.
 
         Padded rows repeat real examples (benign numerics for batch-coupled
         ops) but MUST be zero-weighted in the loss by the caller — see
         ``_padded_lmask`` — or they would silently double-weight samples in
         the gradient."""
         n = next(len(a) for a in arrs if a is not None)
-        if n % self._pad_quantum == 0 and n > 0:
+        q = self._pad_quantum
+        target = bucketing.bucket_size(n) if (
+            bucketing.bucketing_enabled() and n > 0) else n
+        target = max(target, q if n == 0 else n)
+        target = -(-target // q) * q            # round up to the shard quantum
+        if record:
+            bucketing.telemetry().record_hit("dp.fit", n, target)
+        if target == n and n > 0:
             return arrs, n
-        pad = (self._pad_quantum - n % self._pad_quantum) % self._pad_quantum
-        if n == 0:
-            pad = self._pad_quantum
-        return tuple(_tile_pad(a, pad) for a in arrs), n
+        return tuple(_tile_pad(a, target - n) for a in arrs), n
 
     def _even_multihost(self, arrs, n):
         """Equalize each process's PADDED local row count to the global max
@@ -128,6 +166,27 @@ class ParallelWrapper:
         branches — lives in utils.bucketing.padded_label_mask."""
         return padded_label_mask(y, lm, n, scale=scale)
 
+    def _exchange_runner(self):
+        """The explicit-exchange step runner (parallel/grads.py), or None
+        when the implicit dense path applies (both switches off). Built once
+        and kept — its compression residuals must persist across fit calls."""
+        if not (self.grad_compress or self.sharded_update):
+            return None
+        if self._nproc > 1:
+            warnings.warn(
+                "DL4J_TPU_GRAD_COMPRESS/DL4J_TPU_SHARDED_UPDATE are "
+                "single-process only for now; multi-host fit falls back to "
+                "the implicit dense exchange", stacklevel=3)
+            return None
+        if self._runner is None:
+            from deeplearning4j_tpu.parallel.grads import DataParallelStep
+
+            self._runner = DataParallelStep(
+                self.model, self.mesh, compress=self.grad_compress,
+                sharded_update=self.sharded_update,
+                threshold=self.compress_threshold)
+        return self._runner
+
     def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None):
         """Data-parallel fit: identical semantics to ``model.fit`` on a batch
         ``batch_size`` large, executed across all chips."""
@@ -139,43 +198,53 @@ class ParallelWrapper:
         if isinstance(self.model, ComputationGraph):
             return self._fit_graph(data, epochs, batch_size)
         model = self.model
-        for _ in range(epochs):
-            for l in model.listeners:
-                l.on_epoch_start(model, model.epoch)
-            source = data() if callable(data) else data
-            for batch in _iter_batches(source, batch_size):
-                # pad so the batch shards exactly (the reference round-robins
-                # whole DataSets to workers; here the split must be even),
-                # then zero-weight the padded rows in the loss; ew excludes
-                # them from batch-coupled statistics (BatchNorm)
-                (x, y, fm, lm), n = self._pad_to_shardable(batch)
-                if self._nproc > 1:
-                    (x, y, fm, lm), n_tot, gB = self._even_multihost(
-                        (x, y, fm, lm), n)
-                    # global rescale: every real row weighs gB/n_tot so the
-                    # loss equals the single-process mean over n_tot rows
-                    # even when hosts contribute different row counts
-                    lm = (self._padded_lmask(y, lm, n, scale=gB / n_tot)
-                          if n_tot != gB or lm is not None else lm)
-                    padded = n_tot != gB
-                else:
-                    lm = self._padded_lmask(y, lm, n)
-                    padded = len(x) != n
-                ew = None
-                if padded:
-                    ew = np.zeros(len(x), np.float32)
-                    ew[:n] = 1.0
-                score = model._fit_batch(
-                    self._shard(x), self._shard(y), self._shard(fm),
-                    self._shard(lm), ew=self._shard(ew),
-                )
-                if model.listeners:
-                    score = float(score)
-                    for l in model.listeners:
-                        l.iteration_done(model, model.iteration, score, n)
-            for l in model.listeners:
-                l.on_epoch_end(model, model.epoch)
-            model.epoch += 1
+        runner = self._exchange_runner()
+        if runner is not None:
+            runner.begin()
+        try:
+            for _ in range(epochs):
+                for l in model.listeners:
+                    l.on_epoch_start(model, model.epoch)
+                source = data() if callable(data) else data
+                for batch in _iter_batches(source, batch_size):
+                    # pad so the batch shards exactly (the reference
+                    # round-robins whole DataSets to workers; here the split
+                    # must be even), then zero-weight the padded rows in the
+                    # loss; ew excludes them from batch-coupled statistics
+                    # (BatchNorm)
+                    (x, y, fm, lm), n = self._pad_to_shardable(
+                        batch, record=True)
+                    if self._nproc > 1:
+                        (x, y, fm, lm), n_tot, gB = self._even_multihost(
+                            (x, y, fm, lm), n)
+                        # global rescale: every real row weighs gB/n_tot so
+                        # the loss equals the single-process mean over n_tot
+                        # rows even when hosts contribute different row counts
+                        lm = (self._padded_lmask(y, lm, n, scale=gB / n_tot)
+                              if n_tot != gB or lm is not None else lm)
+                        padded = n_tot != gB
+                    else:
+                        lm = self._padded_lmask(y, lm, n)
+                        padded = len(x) != n
+                    ew = None
+                    if padded:
+                        ew = np.zeros(len(x), np.float32)
+                        ew[:n] = 1.0
+                    args = (self._shard(x), self._shard(y), self._shard(fm),
+                            self._shard(lm))
+                    score = (runner.fit_batch(*args, ew=self._shard(ew))
+                             if runner is not None
+                             else model._fit_batch(*args, ew=self._shard(ew)))
+                    if model.listeners:
+                        score = float(score)
+                        for l in model.listeners:
+                            l.iteration_done(model, model.iteration, score, n)
+                for l in model.listeners:
+                    l.on_epoch_end(model, model.epoch)
+                model.epoch += 1
+        finally:
+            if runner is not None:
+                runner.finish()
         return model
 
     def _fit_graph(self, data, epochs: int, batch_size: Optional[int]):
@@ -183,12 +252,24 @@ class ParallelWrapper:
         (features/labels/masks tuples) along the data axis."""
         model = self.model
         shard_t = lambda t: tuple(self._shard(a) for a in t) if t is not None else None
+        runner = self._exchange_runner()
+        if runner is not None:
+            runner.begin()
+        try:
+            self._fit_graph_loop(data, epochs, batch_size, shard_t, runner)
+        finally:
+            if runner is not None:
+                runner.finish()
+        return model
+
+    def _fit_graph_loop(self, data, epochs, batch_size, shard_t, runner):
+        model = self.model
         for _ in range(epochs):
             for l in model.listeners:
                 l.on_epoch_start(model, model.epoch)
             source = data() if callable(data) else data
             for f, lbl, fm, lm in model._iter_multi(source, batch_size):
-                f, n = self._pad_to_shardable(f)
+                f, n = self._pad_to_shardable(f, record=True)
                 if lbl is not None:
                     lbl, _ = self._pad_to_shardable(lbl)
                 if fm is not None:
@@ -232,10 +313,10 @@ class ParallelWrapper:
                     # (BatchNorm vertices) — same channel as the MLN path
                     ew = np.zeros(total, np.float32)
                     ew[:n] = 1.0
-                score = model.fit_batch(
-                    (shard_t(f), shard_t(lbl), shard_t(fm), shard_t(lm)),
-                    ew=self._shard(ew),
-                )
+                sharded = (shard_t(f), shard_t(lbl), shard_t(fm), shard_t(lm))
+                score = (runner.fit_batch_graph(sharded, ew=self._shard(ew))
+                         if runner is not None
+                         else model.fit_batch(sharded, ew=self._shard(ew)))
                 if model.listeners:
                     score = float(score)
                     for l in model.listeners:

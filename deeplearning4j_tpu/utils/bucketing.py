@@ -158,6 +158,7 @@ class BucketTelemetry:
             self.bucket_hits: Dict[Tuple[str, int], int] = {}
             self.padded_examples = 0
             self.real_examples = 0
+            self.comm: Dict[str, Dict[str, int]] = {}
 
     def record_trace(self, site: str, shape: Sequence[int]):
         with self._lock:
@@ -170,6 +171,22 @@ class BucketTelemetry:
             self.bucket_hits[key] = self.bucket_hits.get(key, 0) + 1
             self.real_examples += n
             self.padded_examples += max(bucket - n, 0)
+
+    def record_comm(self, site: str, dense_bytes: int, wire_bytes: int,
+                    param_bytes: int = 0):
+        """Record a site's PER-STEP collective byte accounting (static shape
+        arithmetic, recorded when a DataParallelStep plan is built):
+        ``dense_bytes`` = what a dense all-reduce of the exchanged gradients
+        would move, ``wire_bytes`` = what the configured exchange moves,
+        ``param_bytes`` = sharded-update's extra updated-param all-gather.
+        Latest values win — the numbers describe a configuration, not a
+        running total."""
+        with self._lock:
+            self.comm[site] = {
+                "dense_bytes": int(dense_bytes),
+                "wire_bytes": int(wire_bytes),
+                "param_bytes": int(param_bytes),
+            }
 
     def compiles(self, site: Optional[str] = None) -> int:
         with self._lock:
@@ -191,6 +208,7 @@ class BucketTelemetry:
                                 for (s, b), c in sorted(self.bucket_hits.items())},
                 "padded_examples": self.padded_examples,
                 "real_examples": self.real_examples,
+                "comm": {s: dict(v) for s, v in self.comm.items()},
             }
 
 

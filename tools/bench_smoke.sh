@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Smoke-run the whole bench harness on CPU: tiny shapes, every metric must
+# emit a JSON line (the round-5 lenet5 rc=124 regression class — a bench
+# that hangs or dies is caught here before it costs a real-chip run).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out=$(BENCH_SMOKE=1 JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python bench.py)
+echo "$out"
+
+# every registered metric present, none carrying an "error" field
+python - "$out" <<'EOF'
+import json
+import sys
+
+lines = [json.loads(l) for l in sys.argv[1].strip().splitlines()]
+final = lines[-1]
+extras = final.get("extras", [])
+errors = [m for m in extras if "error" in m]
+if errors:
+    sys.exit(f"bench smoke: metrics with errors: {errors}")
+import bench
+if len(extras) != len(bench._BENCHES):
+    sys.exit(f"bench smoke: {len(extras)} metrics, "
+             f"expected {len(bench._BENCHES)}")
+print(f"bench smoke OK: {len(extras)} metrics, no errors")
+EOF
