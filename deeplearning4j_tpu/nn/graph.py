@@ -43,6 +43,7 @@ from deeplearning4j_tpu.train.updaters import (
     apply_gradient_normalization,
     make_updater,
     normalize_updater,
+    scale_lr,
 )
 
 # ---------------------------------------------------------------------------
@@ -642,12 +643,16 @@ class ComputationGraph:
         self.opt_state: Optional[dict] = None
         self.iteration = 0
         self.epoch = 0
+        self.batch_in_epoch = 0
         self._rng = jax.random.PRNGKey(conf.seed)
         self._step_fn = None
         self._tbptt_step_fn = None
         self._output_fn = None
         self._rnn_carries: Optional[dict] = None
         self.listeners: list = []
+        self.divergence_guard = None
+        self._lr_scale = 1.0
+        self._pending_residuals = None
 
     # -- resolution --------------------------------------------------------
     def _resolve(self):
@@ -730,16 +735,36 @@ class ComputationGraph:
         return self
 
     def _build_updaters(self):
-        default = normalize_updater(self.conf.updater)
+        # _lr_scale is the divergence-guard rollback backoff (resilience.py)
+        scale = float(getattr(self, "_lr_scale", 1.0))
+        default = scale_lr(self.conf.updater, scale)
         self._updaters = {}
         for name in self.topo_order:
             cfg = self.rt[name].config
             if not getattr(cfg, "trainable", True):
                 self._updaters[name] = make_updater("noop")
             elif getattr(cfg, "updater", None) is not None:
-                self._updaters[name] = make_updater(cfg.updater)
+                self._updaters[name] = make_updater(scale_lr(cfg.updater, scale))
             else:
                 self._updaters[name] = make_updater(default)
+
+    def _clear_compiled(self):
+        """Drop compiled step closures (updaters or divergence-guard config
+        changed — both are baked into the trace)."""
+        self._step_fn = None
+        self._tbptt_step_fn = None
+        self._chain_step_fn = None
+
+    def set_divergence_guard(self, guard) -> "ComputationGraph":
+        """Install a train/resilience.DivergenceGuard (None to remove).
+        Clears compiled step caches: the skip_batch policy's select is traced
+        into the step executable."""
+        self.divergence_guard = guard
+        self._clear_compiled()
+        runner = getattr(self, "_dp_runner", None)
+        if runner is not None:
+            runner.rebuild_step()
+        return self
 
     def num_params(self) -> int:
         return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(self.params))
@@ -859,8 +884,15 @@ class ComputationGraph:
         ``MultiLayerNetwork._step_body``: opt_state slot becomes
         ``(opt_state, residuals)``, loss/state are replica-means, the
         signature and return arity stay unchanged."""
+        from deeplearning4j_tpu.train import resilience
+
         order = self.topo_order
         updaters = self._updaters
+        # divergence-guard skip_batch: the accept/reject select is traced
+        # INTO the step (device-side; no extra host sync)
+        guard = getattr(self, "divergence_guard", None)
+        g_skip = bool(guard is not None and guard.policy == "skip_batch")
+        g_limit = None if guard is None else guard.spike_limit
 
         def step(params, opt_state, state, it, rng, inputs, labels, fmasks, lmasks,
                  carries, ex_weight=None):
@@ -883,6 +915,13 @@ class ComputationGraph:
                 new_state = grad_exchange.mean_state(new_state)
                 new_params, new_opt, new_res = grad_exchange.update(
                     grads, params, opt_state, residuals, it)
+                if g_skip:
+                    # loss is already the replica mean → ok is replicated
+                    ok = resilience.guard_ok(loss, g_limit)
+                    new_params = resilience.guard_select(ok, new_params, params)
+                    new_opt = resilience.guard_select(ok, new_opt, opt_state)
+                    new_res = resilience.guard_select(ok, new_res, residuals)
+                    new_state = resilience.guard_select(ok, new_state, state)
                 return (new_params, (new_opt, new_res), new_state,
                         new_carries, loss)
             new_params, new_opt = {}, {}
@@ -908,6 +947,11 @@ class ComputationGraph:
                     p_new = apply_constraints(cfg, p_new)
                 new_params[name] = p_new
                 new_opt[name] = ns
+            if g_skip:
+                ok = resilience.guard_ok(loss, g_limit)
+                new_params = resilience.guard_select(ok, new_params, params)
+                new_opt = resilience.guard_select(ok, new_opt, opt_state)
+                new_state = resilience.guard_select(ok, new_state, state)
             return new_params, new_opt, new_state, new_carries, loss
 
         return step
@@ -1022,18 +1066,36 @@ class ComputationGraph:
         self.listeners = list(listeners)
         return self
 
-    def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None):
+    def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None,
+            resume_from=None):
         """Train on a MultiDataSet batch, an iterable of batches, or a
-        callable returning a fresh iterable per epoch."""
+        callable returning a fresh iterable per epoch.
+
+        ``resume_from``: a CheckpointListener directory — restore the newest
+        VALID checkpoint and continue; ``epochs`` becomes the TOTAL budget
+        and the interrupted epoch skips its already-consumed batches (same
+        contract as MultiLayerNetwork.fit; docs/ROBUSTNESS.md)."""
+        from deeplearning4j_tpu.train import resilience
+
         if self.params is None:
             self.init()
+        resume_skip = 0
+        if resume_from is not None:
+            if resilience.resume(self, resume_from) is not None:
+                resume_skip = int(getattr(self, "batch_in_epoch", 0))
+                epochs = max(epochs - self.epoch, 0)
+        guard = getattr(self, "divergence_guard", None)
         for _ in range(epochs):
+            skip_n, resume_skip = resume_skip, 0
+            self.batch_in_epoch = skip_n
             for l in self.listeners:
                 l.on_epoch_start(self, self.epoch)
             source = data() if callable(data) else data
             tbptt = (self.conf.backprop_type == "tbptt"
                      and bool(self._time_distributed_inputs()))
-            chain_k = self._chain_k() if not (self.listeners or tbptt) else 0
+            chain_k = (self._chain_k()
+                       if not (self.listeners or tbptt) and guard is None
+                       else 0)
             buf: list = []
             # pad every batch (incl. the partial tail) to ONE row count with
             # a uniform ew/lmask calling convention → one compiled step
@@ -1054,7 +1116,14 @@ class ComputationGraph:
                 buf.clear()
 
             def batches():
-                for f, l, fm, lm in self._iter_multi(source, batch_size):
+                it = self._iter_multi(source, batch_size)
+                # resume: already-consumed batches of the interrupted epoch
+                # are skipped HERE, without touching the RNG (the restored
+                # key is already past them)
+                for _ in range(skip_n):
+                    if next(it, None) is None:
+                        return
+                for f, l, fm, lm in it:
                     # real-row count taken HERE, before padding, so the fit
                     # loop never syncs ew back from device to learn it
                     n = len(f[0])
@@ -1083,6 +1152,7 @@ class ComputationGraph:
                 )
                 if chainable:
                     buf.append((f, l))
+                    self.batch_in_epoch += 1
                     if len(buf) == chain_k:
                         flush(True)
                     continue
@@ -1091,12 +1161,18 @@ class ComputationGraph:
                     score = self._fit_tbptt(*batch)
                 else:
                     score = self.fit_batch(batch, ew=ew)
+                self.batch_in_epoch += 1
+                if guard is not None:
+                    guard.observe(self, score)
                 if self.listeners:
                     # n_real came from the pre-padding host side of the stream
                     score = float(score)  # graftlint: disable=host-sync
+                    resilience.note_score(score)
                     for l in self.listeners:
                         l.iteration_done(self, self.iteration, score, n_real)
             flush(False)
+            if guard is not None:
+                guard.flush(self)
             for l in self.listeners:
                 l.on_epoch_end(self, self.epoch)
             self.epoch += 1
@@ -1171,6 +1247,13 @@ class ComputationGraph:
             f, l, fm, lm = batch
         else:
             f, l, fm, lm = self._as_multi_batch(batch)
+        from deeplearning4j_tpu.train import resilience
+
+        chaos = resilience.active_chaos()
+        if chaos is not None:
+            chaos.maybe_preempt(self.iteration)
+            chaos.maybe_slow(self.iteration)
+            f = chaos.maybe_nan_batch(self.iteration, f)
         step = self._get_step_fn(False)
         self.params, self.opt_state, self.state, _, loss = step(
             self.params, self.opt_state, self.state,
@@ -1190,6 +1273,12 @@ class ComputationGraph:
         time-distributed labels/masks), carry RNN-vertex state across chunks
         with stopped gradients. Static ([B,F]) inputs are re-fed whole to
         every chunk — the DuplicateToTimeSeriesVertex use case."""
+        from deeplearning4j_tpu.train import resilience
+
+        chaos = resilience.active_chaos()
+        if chaos is not None:
+            chaos.maybe_preempt(self.iteration)
+            chaos.maybe_slow(self.iteration)
         step = self._get_step_fn(True)
         td_inputs = set(self._time_distributed_inputs())
         T = max(x.shape[1] for n, x in zip(self.conf.inputs, f) if n in td_inputs)

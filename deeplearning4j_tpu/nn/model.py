@@ -40,6 +40,7 @@ from deeplearning4j_tpu.train.updaters import (
     apply_gradient_normalization,
     make_updater,
     normalize_updater,
+    scale_lr,
 )
 
 
@@ -296,12 +297,16 @@ class MultiLayerNetwork:
         self.opt_state = None
         self.iteration = 0
         self.epoch = 0
+        self.batch_in_epoch = 0
         self._rng = jax.random.PRNGKey(conf.seed)
         self._step_fn = None
         self._tbptt_step_fn = None
         self._output_fn = None
         self._rnn_carries: Optional[list] = None
         self.listeners: list = []
+        self.divergence_guard = None
+        self._lr_scale = 1.0
+        self._pending_residuals = None
 
     # -- resolution: preprocessors + n_in inference + per-layer input types --
     def _resolve_layers(self):
@@ -347,15 +352,37 @@ class MultiLayerNetwork:
         return self
 
     def _build_updaters(self):
-        default = normalize_updater(self.conf.updater)
+        # _lr_scale is the divergence-guard rollback backoff (resilience.py);
+        # 1.0 outside rollback, so this is normalize_updater by default
+        scale = float(getattr(self, "_lr_scale", 1.0))
+        default = scale_lr(self.conf.updater, scale)
         self._updaters = []
         for l in self.layers:
             if not getattr(l, "trainable", True):
                 self._updaters.append(make_updater("noop"))
             elif getattr(l, "updater", None) is not None:
-                self._updaters.append(make_updater(l.updater))
+                self._updaters.append(make_updater(scale_lr(l.updater, scale)))
             else:
                 self._updaters.append(make_updater(default))
+
+    def _clear_compiled(self):
+        """Drop compiled step closures (updaters or divergence-guard config
+        changed — both are baked into the trace)."""
+        self._step_fn = None
+        self._tbptt_step_fn = None
+        self._chain_step_fn = None
+        self._solver = None
+
+    def set_divergence_guard(self, guard) -> "MultiLayerNetwork":
+        """Install a train/resilience.DivergenceGuard (None to remove).
+        Clears compiled step caches: the skip_batch policy's select is traced
+        into the step executable."""
+        self.divergence_guard = guard
+        self._clear_compiled()
+        runner = getattr(self, "_dp_runner", None)
+        if runner is not None:
+            runner.rebuild_step()
+        return self
 
     def num_params(self) -> int:
         return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(self.params))
@@ -439,8 +466,15 @@ class MultiLayerNetwork:
         shard_map with per-replica local batches, the opt_state slot carries
         ``(opt_state, residuals)``, and loss/state are replica-means — the
         step's signature and return arity are unchanged."""
+        from deeplearning4j_tpu.train import resilience
+
         updaters = self._updaters
         layers = self.layers
+        # divergence-guard skip_batch: the accept/reject select is traced
+        # INTO the step (device-side; no extra host sync)
+        guard = getattr(self, "divergence_guard", None)
+        g_skip = bool(guard is not None and guard.policy == "skip_batch")
+        g_limit = None if guard is None else guard.spike_limit
 
         def step(params, opt_state, state, it, rng, x, y, fmask, lmask, carries,
                  ex_weight=None):
@@ -464,6 +498,13 @@ class MultiLayerNetwork:
                 new_state = grad_exchange.mean_state(new_state)
                 new_params, new_opt, new_res = grad_exchange.update(
                     grads, params, opt_state, residuals, it)
+                if g_skip:
+                    # loss is already the replica mean → ok is replicated
+                    ok = resilience.guard_ok(loss, g_limit)
+                    new_params = resilience.guard_select(ok, new_params, params)
+                    new_opt = resilience.guard_select(ok, new_opt, opt_state)
+                    new_res = resilience.guard_select(ok, new_res, residuals)
+                    new_state = resilience.guard_select(ok, new_state, state)
                 return (new_params, (new_opt, new_res), new_state,
                         new_carries, loss)
 
@@ -489,7 +530,13 @@ class MultiLayerNetwork:
                     p_new = apply_constraints(layer, p_new)
                 new_params.append(p_new)
                 new_opt.append(new_s)
-            return tuple(new_params), tuple(new_opt), new_state, new_carries, loss
+            out_params, out_opt = tuple(new_params), tuple(new_opt)
+            if g_skip:
+                ok = resilience.guard_ok(loss, g_limit)
+                out_params = resilience.guard_select(ok, out_params, params)
+                out_opt = resilience.guard_select(ok, out_opt, opt_state)
+                new_state = resilience.guard_select(ok, new_state, state)
+            return out_params, out_opt, new_state, new_carries, loss
 
         return step
 
@@ -557,17 +604,36 @@ class MultiLayerNetwork:
             jnp.asarray(self.iteration, jnp.int32), self._next_rng(), xs, ys)
         self.iteration += len(buf)
 
-    def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None):
+    def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None,
+            resume_from=None):
         """Train. ``data``: (x, y[, fmask[, lmask]]) arrays, an iterable of
         such batches, or a callable returning a fresh iterable per epoch
-        (DataSetIterator equivalent)."""
+        (DataSetIterator equivalent).
+
+        ``resume_from``: a CheckpointListener directory — restore the newest
+        VALID checkpoint (params/opt/state, RNG key, iteration/epoch, batch
+        position) and continue. ``epochs`` then counts the TOTAL budget
+        (already-completed epochs are subtracted) and the interrupted epoch
+        skips its already-consumed batches, so the resumed run replays the
+        exact RNG/batch stream of an uninterrupted one (docs/ROBUSTNESS.md)."""
+        from deeplearning4j_tpu.train import resilience
+
         if self.params is None:
             self.init()
+        resume_skip = 0
+        if resume_from is not None:
+            if resilience.resume(self, resume_from) is not None:
+                resume_skip = int(getattr(self, "batch_in_epoch", 0))
+                epochs = max(epochs - self.epoch, 0)
         tbptt = self.conf.backprop_type == "tbptt"
         sgd = self.conf.optimization_algo in (
             "stochastic_gradient_descent", "sgd")
-        chain_k = self._chain_k() if sgd and not self.listeners else 0
+        guard = getattr(self, "divergence_guard", None)
+        chain_k = (self._chain_k()
+                   if sgd and not self.listeners and guard is None else 0)
         for _ in range(epochs):
+            skip_n, resume_skip = resume_skip, 0
+            self.batch_in_epoch = skip_n
             for l in self.listeners:
                 l.on_epoch_start(self, self.epoch)
             source = data() if callable(data) else data
@@ -590,7 +656,14 @@ class MultiLayerNetwork:
                 buf.clear()
 
             def batches():
-                for x, y, fm, lm in _iter_batches(source, batch_size):
+                it = _iter_batches(source, batch_size)
+                # resume: the interrupted epoch's consumed batches are
+                # skipped HERE, before padding/prefetch and without touching
+                # the RNG — the restored key is already past them
+                for _ in range(skip_n):
+                    if next(it, None) is None:
+                        return
+                for x, y, fm, lm in it:
                     # real-row count taken HERE, before padding, so the fit
                     # loop never has to sync ew back from device to learn it
                     n = len(x)
@@ -616,6 +689,7 @@ class MultiLayerNetwork:
                 )
                 if chainable:
                     buf.append((x, y))
+                    self.batch_in_epoch += 1
                     if len(buf) == chain_k:
                         flush(True)
                     continue
@@ -626,14 +700,20 @@ class MultiLayerNetwork:
                     score = self._fit_tbptt(x, y, fm, lm)
                 else:
                     score = self._fit_batch(x, y, fm, lm, ew=ew)
+                self.batch_in_epoch += 1
+                if guard is not None:
+                    guard.observe(self, score)
                 # score is a device scalar; only sync the host when a
                 # listener actually consumes it (keeps dispatch async);
                 # n_real came from the pre-padding host side of the stream
                 if self.listeners:
                     score = float(score)  # graftlint: disable=host-sync
+                    resilience.note_score(score)
                     for l in self.listeners:
                         l.iteration_done(self, self.iteration, score, n_real)
             flush(False)
+            if guard is not None:
+                guard.flush(self)
             for l in self.listeners:
                 l.on_epoch_end(self, self.epoch)
             self.epoch += 1
@@ -644,6 +724,13 @@ class MultiLayerNetwork:
         whether to sync (fit() only syncs when listeners are attached).
         ``ew``: optional per-example validity weight (ParallelWrapper padding)
         consumed by batch-coupled layers — see _forward."""
+        from deeplearning4j_tpu.train import resilience
+
+        chaos = resilience.active_chaos()
+        if chaos is not None:
+            chaos.maybe_preempt(self.iteration)
+            chaos.maybe_slow(self.iteration)
+            x = chaos.maybe_nan_batch(self.iteration, x)
         step = self._get_step_fn(False)
         x = _cast_input(x, self.dtype)
         y = _cast_labels(y, self.dtype)
@@ -680,6 +767,12 @@ class MultiLayerNetwork:
     def _fit_tbptt(self, x, y, fm, lm):
         """Truncated BPTT: chunk the time axis, carry RNN state across chunks
         (doTruncatedBPTT:1514 — forward/backward chunk length unified)."""
+        from deeplearning4j_tpu.train import resilience
+
+        chaos = resilience.active_chaos()
+        if chaos is not None:
+            chaos.maybe_preempt(self.iteration)
+            chaos.maybe_slow(self.iteration)
         step = self._get_step_fn(True)
         T = x.shape[1]
         L = self.conf.tbptt_fwd_length

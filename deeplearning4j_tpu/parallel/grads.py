@@ -290,6 +290,9 @@ class DataParallelStep:
         self._residual = None
         self._active = False
         self._record_comm()
+        # checkpoint/resume integration (train/resilience.py): the save path
+        # finds the active runner here to snapshot flat opt state + residuals
+        model._dp_runner = self
 
     # -- plan ---------------------------------------------------------------
     def _build_plan(self):
@@ -463,6 +466,9 @@ class DataParallelStep:
                           else tuple(opt[k] for k in self._order))
         if self._residual is None:
             self._residual = self._init_residual()
+        # Barrier before the carry enters the donated step chain: begin() runs
+        # once per fit, and a restored model's opt leaves are fresh transfers.
+        jax.block_until_ready(self._opt_flat)  # graftlint: disable=host-sync
         self._active = True
 
     def finish(self):
@@ -485,14 +491,98 @@ class DataParallelStep:
         self._opt_flat = None
         self._active = False
 
+    # -- checkpoint/resume integration (train/resilience.py) -----------------
+    def snapshot_opt_state(self):
+        """The model-structured optimizer state as of NOW, without leaving
+        the exchange layout (``finish`` logic, non-mutating) — what a
+        checkpoint taken mid-fit must record."""
+        if not self._active:
+            return self.model.opt_state
+        flat = self._opt_flat
+        out: Dict[Any, Any] = {}
+        for i, key in enumerate(self._order):
+            e = self._entries.get(key)
+            entry = flat[key] if self.is_graph else flat[i]
+            if e is not None and e.mode == "sharded":
+                out[key] = self._from_flat_opt(e, entry)
+            else:
+                out[key] = entry
+        return out if self.is_graph else tuple(out[k] for k in self._order)
+
+    def export_residuals(self) -> Dict[str, np.ndarray]:
+        """Host copies of the per-replica error-feedback residuals, keyed by
+        ``str(entry key)`` (npz-compatible). Empty when nothing compresses."""
+        if self._residual is None:
+            return {}
+        res = (self._residual if self.is_graph
+               else dict(zip(self._order, self._residual)))
+        return {str(k): np.asarray(v)  # graftlint: disable=host-sync
+                for k, v in res.items() if v is not None}
+
+    def load_residuals(self, arrays: Dict[str, np.ndarray]):
+        """Re-seed the ``[R, n_pad]`` residuals from a checkpoint's host
+        arrays (inverse of ``export_residuals``). Entries absent from
+        ``arrays`` stay zero — dropping them would silently lose pending
+        sub-threshold gradient mass, so restore runs this before fitting."""
+        res: Dict[Any, Any] = {}
+        for key in self._order:
+            e = self._entries.get(key)
+            if e is None or not e.compress:
+                res[key] = None
+                continue
+            a = arrays.get(str(key))
+            if a is None:
+                res[key] = jax.device_put(
+                    jnp.zeros((self.R, e.n_pad), jnp.float32), self._sharded)
+            else:
+                res[key] = jax.device_put(
+                    jnp.asarray(a, jnp.float32).reshape(self.R, e.n_pad),
+                    self._sharded)
+        self._residual = res if self.is_graph else tuple(
+            res[k] for k in self._order)
+        # Barrier: these H2D transfers feed a donated carry; materialize them
+        # before the first step can reuse the buffers (async dispatch race).
+        jax.block_until_ready(self._residual)  # graftlint: disable=host-sync
+
+    def rebuild_step(self):
+        """Re-trace the step (the model's divergence-guard config is baked
+        into the traced body — see model.set_divergence_guard)."""
+        self._step = self._build_step()
+
+    def reload(self):
+        """Re-enter the exchange layout around externally reloaded model
+        state (divergence-guard rollback: params/opt restored from a
+        checkpoint, updaters rebuilt with a backed-off LR). Rebuilds the
+        plan/step so the new updater objects are the ones traced, then
+        re-seeds residuals from the checkpoint when it carried any."""
+        self._active = False
+        self._opt_flat = None
+        self._build_plan()
+        self.exchange = GradExchange(
+            self._entries, self._order,
+            "dict" if self.is_graph else "tuple",
+            "data", self.R, self.threshold)
+        self._step = self._build_step()
+        self.begin()
+        pending = getattr(self.model, "_pending_residuals", None)
+        if pending:
+            self.load_residuals(pending)
+            self.model._pending_residuals = None
+
     # -- dispatch -----------------------------------------------------------
     def fit_batch(self, x, y, fm, lm, ew=None):
         """MultiLayerNetwork step (mirrors ``model._fit_batch``)."""
         from deeplearning4j_tpu.nn.model import _cast_input, _cast_labels
+        from deeplearning4j_tpu.train import resilience
 
         if not self._active:
             self.begin()
         model = self.model
+        chaos = resilience.active_chaos()
+        if chaos is not None:
+            chaos.maybe_preempt(model.iteration)
+            chaos.maybe_slow(model.iteration)
+            x = chaos.maybe_nan_batch(model.iteration, x)
         x = _cast_input(x, model.dtype)
         y = _cast_labels(y, model.dtype)
         fm = jnp.asarray(fm, model.dtype) if fm is not None else None
@@ -511,10 +601,17 @@ class DataParallelStep:
     def fit_batch_graph(self, batch, ew=None):
         """ComputationGraph step (mirrors ``model.fit_batch`` on an
         already-normalized ``(f, l, fm, lm)`` tuple batch)."""
+        from deeplearning4j_tpu.train import resilience
+
         if not self._active:
             self.begin()
         model = self.model
         f, l, fm, lm = batch
+        chaos = resilience.active_chaos()
+        if chaos is not None:
+            chaos.maybe_preempt(model.iteration)
+            chaos.maybe_slow(model.iteration)
+            f = chaos.maybe_nan_batch(model.iteration, f)
         ew = jnp.asarray(ew, model.dtype) if ew is not None else None
         (model.params, (self._opt_flat, self._residual), model.state,
          _, loss) = self._step(

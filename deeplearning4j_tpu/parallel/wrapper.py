@@ -187,26 +187,62 @@ class ParallelWrapper:
                 threshold=self.compress_threshold)
         return self._runner
 
-    def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None):
+    def _restore_runner_residuals(self, runner) -> None:
+        """Hand checkpointed compression residuals (stashed on the model by
+        resume/restore) to the exchange runner — must happen after begin(),
+        which otherwise seeds zeros."""
+        pending = getattr(self.model, "_pending_residuals", None)
+        if pending:
+            runner.load_residuals(pending)
+            self.model._pending_residuals = None
+
+    def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None,
+            resume_from=None):
         """Data-parallel fit: identical semantics to ``model.fit`` on a batch
-        ``batch_size`` large, executed across all chips."""
+        ``batch_size`` large, executed across all chips.
+
+        ``resume_from``: a CheckpointListener directory — restore the newest
+        VALID checkpoint (including the flat-opt snapshot and compression
+        residuals a DP checkpoint carries) and continue; ``epochs`` becomes
+        the TOTAL budget and the interrupted epoch skips its consumed
+        batches (same contract as model.fit; docs/ROBUSTNESS.md)."""
         if self.model.params is None:
             self.model.init()
+        resume_skip = 0
+        if resume_from is not None:
+            from deeplearning4j_tpu.train import resilience
+
+            if resilience.resume(self.model, resume_from) is not None:
+                resume_skip = int(getattr(self.model, "batch_in_epoch", 0))
+                epochs = max(epochs - self.model.epoch, 0)
+                # rebuild the exchange plan around the restored state (the
+                # restored LR scale may have produced new updater objects)
+                self._runner = None
         self._replicate_model()
         from deeplearning4j_tpu.nn.graph import ComputationGraph
 
         if isinstance(self.model, ComputationGraph):
-            return self._fit_graph(data, epochs, batch_size)
+            return self._fit_graph(data, epochs, batch_size, resume_skip)
         model = self.model
+        guard = getattr(model, "divergence_guard", None)
         runner = self._exchange_runner()
         if runner is not None:
             runner.begin()
+            self._restore_runner_residuals(runner)
         try:
             for _ in range(epochs):
+                skip_n, resume_skip = resume_skip, 0
+                model.batch_in_epoch = skip_n
                 for l in model.listeners:
                     l.on_epoch_start(model, model.epoch)
                 source = data() if callable(data) else data
-                for batch in _iter_batches(source, batch_size):
+                batch_iter = _iter_batches(source, batch_size)
+                for _ in range(skip_n):
+                    # resume: skip the interrupted epoch's consumed batches
+                    # (the restored RNG key is already past them)
+                    if next(batch_iter, None) is None:
+                        break
+                for batch in batch_iter:
                     # pad so the batch shards exactly (the reference
                     # round-robins whole DataSets to workers; here the split
                     # must be even), then zero-weight the padded rows in the
@@ -235,10 +271,21 @@ class ParallelWrapper:
                     score = (runner.fit_batch(*args, ew=self._shard(ew))
                              if runner is not None
                              else model._fit_batch(*args, ew=self._shard(ew)))
+                    model.batch_in_epoch += 1
+                    if guard is not None:
+                        guard.observe(model, score)
+                        # rollback may swap the runner's carries under us —
+                        # nothing to do here: runner.reload() re-entered the
+                        # exchange layout before observe() returned
                     if model.listeners:
                         score = float(score)
+                        from deeplearning4j_tpu.train import resilience
+
+                        resilience.note_score(score)
                         for l in model.listeners:
                             l.iteration_done(model, model.iteration, score, n)
+                if guard is not None:
+                    guard.flush(model)
                 for l in model.listeners:
                     l.on_epoch_end(model, model.epoch)
                 model.epoch += 1
@@ -247,7 +294,8 @@ class ParallelWrapper:
                 runner.finish()
         return model
 
-    def _fit_graph(self, data, epochs: int, batch_size: Optional[int]):
+    def _fit_graph(self, data, epochs: int, batch_size: Optional[int],
+                   resume_skip: int = 0):
         """ComputationGraph variant: shard every member of the MultiDataSet
         (features/labels/masks tuples) along the data axis."""
         model = self.model
@@ -255,20 +303,31 @@ class ParallelWrapper:
         runner = self._exchange_runner()
         if runner is not None:
             runner.begin()
+            self._restore_runner_residuals(runner)
         try:
-            self._fit_graph_loop(data, epochs, batch_size, shard_t, runner)
+            self._fit_graph_loop(data, epochs, batch_size, shard_t, runner,
+                                 resume_skip)
         finally:
             if runner is not None:
                 runner.finish()
         return model
 
-    def _fit_graph_loop(self, data, epochs, batch_size, shard_t, runner):
+    def _fit_graph_loop(self, data, epochs, batch_size, shard_t, runner,
+                        resume_skip: int = 0):
         model = self.model
+        guard = getattr(model, "divergence_guard", None)
         for _ in range(epochs):
+            skip_n, resume_skip = resume_skip, 0
+            model.batch_in_epoch = skip_n
             for l in model.listeners:
                 l.on_epoch_start(model, model.epoch)
             source = data() if callable(data) else data
-            for f, lbl, fm, lm in model._iter_multi(source, batch_size):
+            batch_iter = model._iter_multi(source, batch_size)
+            for _ in range(skip_n):
+                # resume: skip the interrupted epoch's consumed batches
+                if next(batch_iter, None) is None:
+                    break
+            for f, lbl, fm, lm in batch_iter:
                 f, n = self._pad_to_shardable(f, record=True)
                 if lbl is not None:
                     lbl, _ = self._pad_to_shardable(lbl)
@@ -317,10 +376,18 @@ class ParallelWrapper:
                 score = (runner.fit_batch_graph(sharded, ew=self._shard(ew))
                          if runner is not None
                          else model.fit_batch(sharded, ew=self._shard(ew)))
+                model.batch_in_epoch += 1
+                if guard is not None:
+                    guard.observe(model, score)
                 if model.listeners:
                     score = float(score)
+                    from deeplearning4j_tpu.train import resilience
+
+                    resilience.note_score(score)
                     for l in model.listeners:
                         l.iteration_done(model, model.iteration, score, n)
+            if guard is not None:
+                guard.flush(model)
             for l in model.listeners:
                 l.on_epoch_end(model, model.epoch)
             model.epoch += 1

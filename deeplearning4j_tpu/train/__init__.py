@@ -13,7 +13,19 @@ from deeplearning4j_tpu.train.updaters import (
     Updater,
     make_updater,
     normalize_updater,
+    scale_lr,
     schedule_value,
+)
+from deeplearning4j_tpu.train.resilience import (
+    ChaosInjector,
+    ChaosPreemption,
+    DivergenceError,
+    DivergenceGuard,
+    active_chaos,
+    install_chaos,
+    resume,
+    save_checkpoint,
+    validate_checkpoint,
 )
 from deeplearning4j_tpu.train.listeners import (
     BaseTrainingListener,
@@ -47,7 +59,17 @@ __all__ = [
     "Updater",
     "make_updater",
     "normalize_updater",
+    "scale_lr",
     "schedule_value",
+    "ChaosInjector",
+    "ChaosPreemption",
+    "DivergenceError",
+    "DivergenceGuard",
+    "active_chaos",
+    "install_chaos",
+    "resume",
+    "save_checkpoint",
+    "validate_checkpoint",
     "TrainingListener",
     "BaseTrainingListener",
     "ProfilerListener",

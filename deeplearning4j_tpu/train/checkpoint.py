@@ -4,6 +4,13 @@ Parity: optimize/listeners/checkpoint/CheckpointListener.java:72
 (saveEveryNEpochs:83, saveEveryNIterations, saveEvery(time), keepAll,
 keepLast:79, keepLastAndEvery:37-65) plus the static restore helpers
 (loadCheckpoint, lastCheckpoint).
+
+Durability (train/resilience.py): saves route through
+``resilience.save_checkpoint`` — atomic zip write + full train state (RNG
+key, batch position, LR scale, DP residuals) — and each index entry records
+the file's CRC32 + size so ``last_valid_checkpoint`` can skip corrupt or
+truncated files when resuming. Time-based saves use ``time.monotonic()``
+(wall-clock steps must not suppress or duplicate saves).
 """
 
 from __future__ import annotations
@@ -24,6 +31,8 @@ class Checkpoint:
     epoch: int
     timestamp: float
     filename: str
+    crc: Optional[int] = None
+    size: Optional[int] = None
 
 
 class CheckpointListener(TrainingListener):
@@ -64,7 +73,7 @@ class CheckpointListener(TrainingListener):
         self.keep_all = keep_all
         self.keep_last = keep_last
         self.keep_last_and_every = keep_last_and_every
-        self._last_save_time = time.time()
+        self._last_save_time = time.monotonic()
         self._count = self._load_count()
 
     # -- listener hooks ----------------------------------------------------
@@ -76,7 +85,7 @@ class CheckpointListener(TrainingListener):
         ):
             self._save(model)
         elif self.save_every_seconds and (
-            time.time() - self._last_save_time >= self.save_every_seconds
+            time.monotonic() - self._last_save_time >= self.save_every_seconds
         ):
             self._save(model)
 
@@ -102,12 +111,13 @@ class CheckpointListener(TrainingListener):
         return []
 
     def _save(self, model):
-        from deeplearning4j_tpu.utils.serialization import save_network
+        from deeplearning4j_tpu.train import resilience
 
         num = self._count
         self._count += 1
         fname = f"checkpoint_{num}_iter_{model.iteration}_epoch_{model.epoch}.zip"
-        save_network(model, os.path.join(self.directory, fname))
+        path = os.path.join(self.directory, fname)
+        info = resilience.save_checkpoint(model, path)
         entries = self._load_index()
         entries.append(
             {
@@ -116,10 +126,17 @@ class CheckpointListener(TrainingListener):
                 "epoch": model.epoch,
                 "timestamp": time.time(),
                 "filename": fname,
+                "crc": info["crc"],
+                "size": info["size"],
             }
         )
         self._write_index(entries)
-        self._last_save_time = time.time()
+        self._last_save_time = time.monotonic()
+        # chaos corruption lands AFTER the CRC is recorded: validation, not
+        # the write path, must be what catches the damaged file
+        chaos = resilience.active_chaos()
+        if chaos is not None:
+            chaos.maybe_corrupt(path, num)
         self._apply_retention(entries)
 
     def _apply_retention(self, entries: List[dict]):
@@ -148,12 +165,15 @@ class CheckpointListener(TrainingListener):
         self._write_index(remaining)
 
     def _write_index(self, entries: List[dict]) -> None:
-        """ATOMIC index write (temp + os.replace): a process killed mid-save
-        — or a concurrent reader polling for resume — must never observe a
-        truncated checkpointInfo.json (the preemption-recovery contract)."""
+        """ATOMIC index write (temp + fsync + os.replace): a process killed
+        mid-save — or a concurrent reader polling for resume — must never
+        observe a truncated checkpointInfo.json (the preemption-recovery
+        contract)."""
         tmp = self._index_path() + f".{os.getpid()}.tmp"
         with open(tmp, "w") as f:
             json.dump(entries, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, self._index_path())
 
     # -- static inspection/restore helpers ---------------------------------
@@ -164,12 +184,26 @@ class CheckpointListener(TrainingListener):
             return []
         with open(idx) as f:
             return [Checkpoint(e["number"], e["iteration"], e["epoch"],
-                               e["timestamp"], e["filename"]) for e in json.load(f)]
+                               e["timestamp"], e["filename"],
+                               e.get("crc"), e.get("size"))
+                    for e in json.load(f)]
 
     @staticmethod
     def last_checkpoint(directory) -> Optional[Checkpoint]:
         cps = CheckpointListener.checkpoints(directory)
         return cps[-1] if cps else None
+
+    @staticmethod
+    def last_valid_checkpoint(directory) -> Optional[Checkpoint]:
+        """Newest checkpoint whose file passes CRC/size (or structural)
+        validation — corrupt or truncated files fall through to older ones."""
+        from deeplearning4j_tpu.train import resilience
+
+        for c in reversed(CheckpointListener.checkpoints(directory)):
+            path = os.path.join(str(directory), c.filename)
+            if resilience.validate_checkpoint(path, crc=c.crc, size=c.size):
+                return c
+        return None
 
     @staticmethod
     def load_checkpoint(directory, number: int):
@@ -185,6 +219,15 @@ class CheckpointListener(TrainingListener):
         c = CheckpointListener.last_checkpoint(directory)
         if c is None:
             raise FileNotFoundError(f"No checkpoints in {directory}")
+        from deeplearning4j_tpu.utils.serialization import restore_network
+
+        return restore_network(os.path.join(str(directory), c.filename))
+
+    @staticmethod
+    def load_last_valid_checkpoint(directory):
+        c = CheckpointListener.last_valid_checkpoint(directory)
+        if c is None:
+            raise FileNotFoundError(f"No valid checkpoints in {directory}")
         from deeplearning4j_tpu.utils.serialization import restore_network
 
         return restore_network(os.path.join(str(directory), c.filename))

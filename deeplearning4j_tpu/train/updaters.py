@@ -63,6 +63,16 @@ def normalize_updater(spec: Any) -> dict:
     return out
 
 
+def scale_lr(spec: Any, scale: float) -> dict:
+    """Normalized updater spec with its base LR multiplied by ``scale`` —
+    the divergence-guard rollback backoff (train/resilience.py). A no-op at
+    scale 1.0 and for LR-free updaters (adadelta, noop)."""
+    cfg = normalize_updater(spec)
+    if scale != 1.0 and "lr" in cfg:
+        cfg = dict(cfg, lr=cfg["lr"] * float(scale))
+    return cfg
+
+
 # ---------------------------------------------------------------------------
 # Learning-rate schedules (reference: LearningRatePolicy + ISchedule impls)
 # ---------------------------------------------------------------------------

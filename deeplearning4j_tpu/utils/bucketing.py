@@ -159,6 +159,7 @@ class BucketTelemetry:
             self.padded_examples = 0
             self.real_examples = 0
             self.comm: Dict[str, Dict[str, int]] = {}
+            self.guard_events: Dict[str, int] = {}
 
     def record_trace(self, site: str, shape: Sequence[int]):
         with self._lock:
@@ -188,6 +189,14 @@ class BucketTelemetry:
                 "param_bytes": int(param_bytes),
             }
 
+    def record_guard(self, event: str):
+        """Count one divergence-guard event (``invalid_score``, a policy trip
+        ``warn``/``skip_batch``/``rollback``, or ``rollback_restore``) — the
+        InvalidScoreIterationTerminationCondition-style counters surfaced in
+        snapshots (train/resilience.py)."""
+        with self._lock:
+            self.guard_events[event] = self.guard_events.get(event, 0) + 1
+
     def compiles(self, site: Optional[str] = None) -> int:
         with self._lock:
             if site is not None:
@@ -209,6 +218,7 @@ class BucketTelemetry:
                 "padded_examples": self.padded_examples,
                 "real_examples": self.real_examples,
                 "comm": {s: dict(v) for s, v in self.comm.items()},
+                "guard": dict(self.guard_events),
             }
 
 

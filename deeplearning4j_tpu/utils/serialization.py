@@ -7,6 +7,14 @@ config + params + optimizer state + step counters) with npz tensors instead
 of a flattened binary view. The JSON config inside the zip is the long-lived
 artifact the reference regression-tests across releases (SURVEY.md §4).
 
+Durability (train/resilience.py): path targets are written atomically —
+tmp file in the destination directory + fsync + ``os.replace`` + directory
+fsync — so a kill mid-save leaves either the previous checkpoint or the new
+one, never a torn file. Full-state checkpoints add ``trainState.json`` (RNG
+key, batch-in-epoch position, LR scale, telemetry snapshot) and
+``residuals.npz`` (PR-3 data-parallel compression residuals); both are
+optional entries, so older zips restore unchanged.
+
 No pickle anywhere: configs are JSON, tensors are npz — a checkpoint from an
 untrusted source cannot execute code on load.
 """
@@ -15,6 +23,7 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import zipfile
 from typing import Optional
 
@@ -27,6 +36,8 @@ STATE_ENTRY = "state.npz"
 UPDATER_ENTRY = "updaterState.npz"
 META_ENTRY = "meta.json"
 NORMALIZER_ENTRY = "normalizer.json"
+TRAIN_STATE_ENTRY = "trainState.json"
+RESIDUALS_ENTRY = "residuals.npz"
 
 
 def _tree_to_npz_bytes(tree) -> bytes:
@@ -55,8 +66,38 @@ def _restore_tree_like(template, leaves):
     )
 
 
-def save_network(model, path, save_updater: bool = True, normalizer: Optional[dict] = None):
-    """Write a model (MultiLayerNetwork or ComputationGraph) to a zip."""
+def _atomic_write_zip(path, write_entries) -> None:
+    """Write a zip durably: tmp in the same directory, fsync the file, swap
+    it in with ``os.replace``, then fsync the directory so the rename itself
+    survives a crash (the checkpointInfo.json index uses the same dance)."""
+    target = os.fspath(path)
+    directory = os.path.dirname(os.path.abspath(target))
+    tmp = target + ".tmp"
+    with open(tmp, "wb") as f:
+        with zipfile.ZipFile(f, "w", zipfile.ZIP_DEFLATED) as zf:
+            write_entries(zf)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, target)
+    dfd = os.open(directory or ".", os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+
+
+def save_network(model, path, save_updater: bool = True,
+                 normalizer: Optional[dict] = None,
+                 train_state: Optional[dict] = None,
+                 residuals: Optional[dict] = None,
+                 opt_state=None):
+    """Write a model (MultiLayerNetwork or ComputationGraph) to a zip.
+
+    ``train_state``/``residuals`` add the full-state entries (see
+    train/resilience.py); ``opt_state`` overrides ``model.opt_state`` for the
+    updater entry (a DataParallelStep snapshots its flat exchange layout back
+    to the structured form mid-fit). Path targets are written atomically;
+    file-like targets are written directly."""
     meta = {
         "framework": "deeplearning4j_tpu",
         "format_version": 1,
@@ -64,33 +105,110 @@ def save_network(model, path, save_updater: bool = True, normalizer: Optional[di
         "epoch": getattr(model, "epoch", 0),
         "model_class": type(model).__name__,
     }
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+    opt = model.opt_state if opt_state is None else opt_state
+
+    def write_entries(zf):
         zf.writestr(CONFIG_ENTRY, model.conf.to_json(indent=2))
         zf.writestr(COEFFICIENTS_ENTRY, _tree_to_npz_bytes(model.params))
         zf.writestr(STATE_ENTRY, _tree_to_npz_bytes(model.state))
-        if save_updater and model.opt_state is not None:
-            zf.writestr(UPDATER_ENTRY, _tree_to_npz_bytes(model.opt_state))
+        if save_updater and opt is not None:
+            zf.writestr(UPDATER_ENTRY, _tree_to_npz_bytes(opt))
         if normalizer is not None:
             zf.writestr(NORMALIZER_ENTRY, json.dumps(normalizer))
+        if train_state is not None:
+            zf.writestr(TRAIN_STATE_ENTRY, json.dumps(train_state))
+        if residuals is not None:
+            buf = io.BytesIO()
+            np.savez(buf, **{k: np.asarray(v) for k, v in residuals.items()})
+            zf.writestr(RESIDUALS_ENTRY, buf.getvalue())
         zf.writestr(META_ENTRY, json.dumps(meta))
+
+    if isinstance(path, (str, os.PathLike)):
+        _atomic_write_zip(path, write_entries)
+    else:
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+            write_entries(zf)
     return path
+
+
+def read_snapshot(path, load_updater: bool = True) -> dict:
+    """Read every entry of a checkpoint zip into plain host data (no model
+    construction): config dict, meta, leaf lists, and the optional
+    train-state/residual extras."""
+    with zipfile.ZipFile(path, "r") as zf:
+        names = set(zf.namelist())
+        snap = {
+            "conf": json.loads(zf.read(CONFIG_ENTRY)),
+            "meta": json.loads(zf.read(META_ENTRY)) if META_ENTRY in names else {},
+            "coeff": _npz_bytes_to_leaves(zf.read(COEFFICIENTS_ENTRY)),
+            "state": (
+                _npz_bytes_to_leaves(zf.read(STATE_ENTRY)) if STATE_ENTRY in names else None
+            ),
+            "upd": (
+                _npz_bytes_to_leaves(zf.read(UPDATER_ENTRY))
+                if load_updater and UPDATER_ENTRY in names
+                else None
+            ),
+            "train_state": (
+                json.loads(zf.read(TRAIN_STATE_ENTRY)) if TRAIN_STATE_ENTRY in names else None
+            ),
+            "residuals": None,
+        }
+        if RESIDUALS_ENTRY in names:
+            with np.load(io.BytesIO(zf.read(RESIDUALS_ENTRY))) as z:
+                snap["residuals"] = {k: z[k] for k in z.files}
+    return snap
+
+
+def apply_snapshot(model, snap: dict, load_updater: bool = True):
+    """Apply a :func:`read_snapshot` result onto an initialized model:
+    params/state/opt trees, iteration/epoch, and — when present — the
+    train-state extras (RNG key, batch position, LR scale) and pending DP
+    residuals (picked up by the next DataParallelStep ``begin()``)."""
+    model.params = _restore_tree_like(model.params, snap["coeff"])
+    if snap["state"] is not None:
+        model.state = _restore_tree_like(model.state, snap["state"])
+    if load_updater and snap["upd"] is not None:
+        model.opt_state = _restore_tree_like(model.opt_state, snap["upd"])
+    meta = snap["meta"]
+    model.iteration = meta.get("iteration", 0)
+    model.epoch = meta.get("epoch", 0)
+    ts = snap.get("train_state")
+    if ts:
+        _apply_train_state(model, ts)
+    model._pending_residuals = snap.get("residuals")
+    # Barrier: the restored leaves are fresh host->device transfers about to
+    # enter a donate_argnums step chain; materialize them before the first
+    # step can reuse their buffers (async dispatch + donation race).
+    import jax
+
+    jax.block_until_ready(  # graftlint: disable=host-sync
+        (model.params, model.state, model.opt_state))
+    return model
+
+
+def _apply_train_state(model, ts: dict) -> None:
+    import jax.numpy as jnp
+
+    rng = ts.get("rng")
+    if rng is not None and getattr(model, "_rng", None) is not None:
+        model._rng = jnp.asarray(
+            np.asarray(rng, dtype=ts.get("rng_dtype", "uint32")))
+    model.batch_in_epoch = int(ts.get("batch_in_epoch", 0))
+    scale = float(ts.get("lr_scale", 1.0))
+    prev = float(getattr(model, "_lr_scale", 1.0))
+    model._lr_scale = scale
+    if scale != prev and hasattr(model, "_build_updaters"):
+        model._build_updaters()
+        if hasattr(model, "_clear_compiled"):
+            model._clear_compiled()
 
 
 def restore_network(path, load_updater: bool = True):
     """Restore a model saved by :func:`save_network`. Dispatches on the config
     format tag (ModelGuesser-style: one entry point for either model class)."""
-    with zipfile.ZipFile(path, "r") as zf:
-        conf_json = json.loads(zf.read(CONFIG_ENTRY))
-        meta = json.loads(zf.read(META_ENTRY)) if META_ENTRY in zf.namelist() else {}
-        coeff = _npz_bytes_to_leaves(zf.read(COEFFICIENTS_ENTRY))
-        state = (
-            _npz_bytes_to_leaves(zf.read(STATE_ENTRY)) if STATE_ENTRY in zf.namelist() else None
-        )
-        upd = (
-            _npz_bytes_to_leaves(zf.read(UPDATER_ENTRY))
-            if load_updater and UPDATER_ENTRY in zf.namelist()
-            else None
-        )
+    snap = read_snapshot(path, load_updater=load_updater)
+    conf_json = snap["conf"]
 
     fmt = conf_json.get("format", "")
     if fmt.endswith("ComputationGraphConfiguration"):
@@ -104,14 +222,7 @@ def restore_network(path, load_updater: bool = True):
         conf = MultiLayerConfiguration.from_dict(conf_json)
         model = MultiLayerNetwork(conf).init()
 
-    model.params = _restore_tree_like(model.params, coeff)
-    if state is not None:
-        model.state = _restore_tree_like(model.state, state)
-    if upd is not None:
-        model.opt_state = _restore_tree_like(model.opt_state, upd)
-    model.iteration = meta.get("iteration", 0)
-    model.epoch = meta.get("epoch", 0)
-    return model
+    return apply_snapshot(model, snap, load_updater=load_updater)
 
 
 def restore_normalizer(path) -> Optional[dict]:

@@ -22,12 +22,15 @@ _provision_cpu_mesh(8)
 
 import jax  # noqa: E402  (import after env vars so they take effect)
 
-# Persistent compilation cache: jit programs recompile identically across
-# test runs (and across rounds), so pay each XLA compile once, not per run.
-_CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache")
-jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+# NOTE: the persistent compilation cache (jax_compilation_cache_dir) is
+# deliberately NOT enabled here. In this jaxlib, executables deserialized
+# from the persistent cache corrupt the heap on XLA:CPU ("corrupted
+# double-linked list" aborts, segfaults inside fit_batch, and — worst —
+# silently poisoned optimizer-state buffers under donate_argnums). Every
+# model instance jits fresh function objects, so a warm cache gets hit
+# constantly in-process; the long-standing tier-1 crash in the imported-CG
+# fit_batch and the flaky DP resume-parity corruption were both this.
+# Reproduce: enable the cache, run any wrapper fit twice in one process.
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

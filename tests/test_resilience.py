@@ -1,0 +1,441 @@
+"""Fault-tolerant training runtime (train/resilience.py).
+
+The load-bearing property is RESUME PARITY: a run preempted at iteration k
+and resumed from its last valid checkpoint must reach the IDENTICAL final
+state (params, optimizer state, RNG stream) as the uninterrupted run —
+bit-exact on CPU, including dropout RNG position and the PR-3 compression
+residuals riding the data-parallel exchange. Plus: corrupt-checkpoint
+fallback, divergence-guard policies, and the chaos grammar itself.
+"""
+
+import os
+import warnings
+import zipfile
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu.nn.graph import ComputationGraph, ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+from deeplearning4j_tpu.nn.model import MultiLayerConfiguration, MultiLayerNetwork
+from deeplearning4j_tpu.parallel import MeshSpec, ParallelWrapper, make_mesh
+from deeplearning4j_tpu.train import resilience
+from deeplearning4j_tpu.train.checkpoint import CheckpointListener
+from deeplearning4j_tpu.train.resilience import (
+    ChaosInjector,
+    ChaosPreemption,
+    DivergenceError,
+    DivergenceGuard,
+    corrupt_file,
+    install_chaos,
+)
+from deeplearning4j_tpu.utils import bucketing
+from deeplearning4j_tpu.utils import serialization as S
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    yield
+    install_chaos(None)
+
+
+def _mln(seed=3, dropout=0.0, lr=1e-2):
+    conf = MultiLayerConfiguration(
+        layers=(
+            Dense(n_out=8, activation="tanh",
+                  **({"dropout": dropout} if dropout else {})),
+            OutputLayer(n_out=3, activation="softmax"),
+        ),
+        input_type=InputType.feed_forward(4),
+        updater={"type": "adam", "lr": lr},
+        seed=seed,
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _cg(seed=3):
+    conf = (
+        ComputationGraphConfiguration.builder()
+        .add_inputs("in")
+        .set_input_types(InputType.feed_forward(4))
+        .add_layer("h", Dense(n_out=8, activation="tanh"), "in")
+        .add_layer("out", OutputLayer(n_out=3, activation="softmax"), "h")
+        .set_outputs("out")
+        .updater({"type": "adam", "lr": 1e-2})
+        .seed(seed)
+        .build()
+    )
+    return ComputationGraph(conf).init()
+
+
+def _data(n=48, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, n)]
+    return x, y
+
+
+def _leaves(tree):
+    return [np.asarray(a) for a in jax.tree_util.tree_leaves(tree)]
+
+
+def _assert_trees_equal(a, b, msg=""):
+    la, lb = _leaves(a), _leaves(b)
+    assert len(la) == len(lb), msg
+    for u, v in zip(la, lb):
+        np.testing.assert_array_equal(u, v, err_msg=msg)
+
+
+# ---------------------------------------------------------------------------
+# Chaos grammar
+# ---------------------------------------------------------------------------
+
+
+class TestChaosGrammar:
+    def test_parse_full_spec(self):
+        inj = ChaosInjector.parse(
+            "preempt@iter:8:kill, corrupt_ckpt@ckpt:2:truncate,"
+            "nan_grad,slow_iter:0.01")
+        kinds = [f.kind for f in inj.faults]
+        assert kinds == ["preempt", "corrupt_ckpt", "nan_grad", "slow_iter"]
+        assert inj.faults[0].at_iter == 8 and inj.faults[0].arg == "kill"
+        assert inj.faults[1].at_ckpt == 2 and inj.faults[1].arg == "truncate"
+        assert inj.faults[2].at_iter is None and inj.faults[2].at_ckpt is None
+        assert inj.faults[3].arg == "0.01"
+
+    @pytest.mark.parametrize("bad", [
+        "explode",                 # unknown kind
+        "preempt@step:3",          # unknown anchor
+        "preempt@iter:",           # missing anchor value
+        "nan_grad@iter",           # anchor without value at all
+    ])
+    def test_parse_errors(self, bad):
+        with pytest.raises(ValueError):
+            ChaosInjector.parse(bad)
+
+    def test_preempt_fires_once_at_or_after_anchor(self):
+        inj = ChaosInjector.parse("preempt@iter:5")
+        inj.maybe_preempt(4)  # before the anchor: nothing
+        with pytest.raises(ChaosPreemption):
+            inj.maybe_preempt(7)  # >= anchor (iteration counters can jump)
+        inj.maybe_preempt(8)  # one-shot: consumed
+
+    def test_nan_grad_fires_once_and_preserves_ints(self):
+        inj = ChaosInjector.parse("nan_grad@iter:2")
+        x = (np.ones((4, 3), np.float32), np.arange(4, dtype=np.int32))
+        same = inj.maybe_nan_batch(1, x)
+        assert same is x
+        poisoned = inj.maybe_nan_batch(2, x)
+        assert np.isnan(np.asarray(poisoned[0])).all()
+        assert poisoned[0].dtype == np.float32
+        np.testing.assert_array_equal(np.asarray(poisoned[1]), x[1])
+        assert inj.maybe_nan_batch(2, x) is x  # one-shot
+
+    def test_corrupt_file_modes(self, tmp_path):
+        p = tmp_path / "blob.bin"
+        payload = bytes(range(256)) * 8
+        p.write_bytes(payload)
+        crc0 = resilience.crc32_file(p)
+        corrupt_file(str(p), mode="bitflip")
+        assert os.path.getsize(p) == len(payload)  # size unchanged
+        assert resilience.crc32_file(p) != crc0    # but CRC catches it
+        corrupt_file(str(p), mode="truncate")
+        assert os.path.getsize(p) == len(payload) // 2
+        with pytest.raises(ValueError):
+            corrupt_file(str(p), mode="melt")
+
+    def test_install_override_beats_env(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_CHAOS", "slow_iter:0.001")
+        inj = resilience.active_chaos()
+        assert inj is not None and inj.faults[0].kind == "slow_iter"
+        # env injector is cached per spec: one-shot state must persist
+        assert resilience.active_chaos() is inj
+        override = install_chaos("nan_grad@iter:1")
+        assert resilience.active_chaos() is override
+        install_chaos(None)
+        assert resilience.active_chaos() is inj
+
+
+# ---------------------------------------------------------------------------
+# Durable checkpoints
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpointDurability:
+    def test_full_state_round_trip(self, tmp_path):
+        x, y = _data()
+        m = _mln(dropout=0.2)
+        m.fit((x, y), epochs=1, batch_size=16)
+        p = str(tmp_path / "full.zip")
+        info = resilience.save_checkpoint(m, p)
+        assert info["crc"] == resilience.crc32_file(p)
+        assert info["size"] == os.path.getsize(p)
+        with zipfile.ZipFile(p) as zf:
+            names = set(zf.namelist())
+        assert S.TRAIN_STATE_ENTRY in names
+        # no stray tmp files from the atomic write
+        assert [f for f in os.listdir(tmp_path) if f != "full.zip"] == []
+
+        m2 = _mln(seed=99, dropout=0.2)  # different init: must be overwritten
+        resilience.load_state_into(m2, p)
+        _assert_trees_equal(m.params, m2.params, "params")
+        _assert_trees_equal(m.opt_state, m2.opt_state, "opt_state")
+        assert m2.iteration == m.iteration and m2.epoch == m.epoch
+        np.testing.assert_array_equal(np.asarray(m._rng), np.asarray(m2._rng))
+
+    def test_validate_checkpoint(self, tmp_path):
+        x, y = _data()
+        m = _mln()
+        m.fit((x, y), epochs=1, batch_size=16)
+        p = str(tmp_path / "v.zip")
+        info = resilience.save_checkpoint(m, p)
+        assert resilience.validate_checkpoint(p, crc=info["crc"], size=info["size"])
+        assert resilience.validate_checkpoint(p)  # legacy structural check
+        assert not resilience.validate_checkpoint(p, crc=info["crc"] ^ 1)
+        assert not resilience.validate_checkpoint(p, size=info["size"] + 1)
+        assert not resilience.validate_checkpoint(str(tmp_path / "missing.zip"))
+        corrupt_file(p, mode="truncate")
+        assert not resilience.validate_checkpoint(p, crc=info["crc"], size=info["size"])
+        assert not resilience.validate_checkpoint(p)
+
+    @pytest.mark.parametrize("mode", ["bitflip", "truncate"])
+    def test_corrupt_newest_falls_back_to_previous(self, tmp_path, mode):
+        x, y = _data()
+        m = _mln()
+        m.set_listeners(CheckpointListener(
+            tmp_path, save_every_n_iterations=2, keep_all=True,
+            delete_existing=True))
+        m.fit((x, y), epochs=2, batch_size=16)  # 6 iterations -> ckpts 0,1,2
+        cps = CheckpointListener.checkpoints(tmp_path)
+        assert len(cps) == 3 and all(c.crc is not None for c in cps)
+        corrupt_file(os.path.join(str(tmp_path), cps[-1].filename), mode=mode)
+        valid = CheckpointListener.last_valid_checkpoint(tmp_path)
+        assert valid is not None and valid.number == cps[-2].number
+
+        m2 = _mln(seed=99)
+        cp = resilience.resume(m2, tmp_path)
+        assert cp.number == cps[-2].number
+
+    def test_chaos_corruption_lands_after_crc(self, tmp_path):
+        """corrupt_ckpt damages the file AFTER its CRC is recorded, so the
+        recorded CRC must expose the damage (the whole point of the fault)."""
+        install_chaos("corrupt_ckpt@ckpt:2:bitflip")
+        x, y = _data()
+        m = _mln()
+        m.set_listeners(CheckpointListener(
+            tmp_path, save_every_n_iterations=2, keep_all=True,
+            delete_existing=True))
+        m.fit((x, y), epochs=2, batch_size=16)
+        cps = CheckpointListener.checkpoints(tmp_path)
+        by_num = {c.number: c for c in cps}
+        p2 = os.path.join(str(tmp_path), by_num[2].filename)
+        assert not resilience.validate_checkpoint(
+            p2, crc=by_num[2].crc, size=by_num[2].size)
+        assert CheckpointListener.last_valid_checkpoint(tmp_path).number == 1
+
+    def test_resume_from_empty_dir_warns_and_trains(self, tmp_path):
+        x, y = _data()
+        m = _mln()
+        with pytest.warns(UserWarning, match="no valid checkpoint"):
+            m.fit((x, y), epochs=1, batch_size=16, resume_from=tmp_path)
+        assert m.epoch == 1
+
+
+# ---------------------------------------------------------------------------
+# Resume parity: preempted + resumed == uninterrupted (bit-exact)
+# ---------------------------------------------------------------------------
+
+
+def _fit_with_preemption(model, data, ckdir, at_iter, epochs=2, batch_size=16):
+    model.set_listeners(CheckpointListener(
+        ckdir, save_every_n_iterations=2, keep_all=True, delete_existing=True))
+    install_chaos(f"preempt@iter:{at_iter}")
+    with pytest.raises(ChaosPreemption):
+        model.fit(data, epochs=epochs, batch_size=batch_size)
+    install_chaos(None)
+
+
+class TestResumeParity:
+    def test_mln_resume_bit_exact_with_dropout(self, tmp_path):
+        """Preempt mid-epoch-2, resume into a FRESH model, and land on the
+        identical final params/opt-state/counters as the uninterrupted run.
+        Dropout makes this strict: it only holds if the RNG key was restored
+        and the already-consumed batches are skipped WITHOUT advancing it."""
+        data = _data(64)
+        cont = _mln(dropout=0.2)
+        cont.fit(data, epochs=2, batch_size=16)  # 8 iterations total
+
+        m = _mln(dropout=0.2)
+        _fit_with_preemption(m, data, tmp_path, at_iter=6)
+
+        r = _mln(seed=99, dropout=0.2)
+        r.fit(data, epochs=2, batch_size=16, resume_from=tmp_path)
+        _assert_trees_equal(cont.params, r.params, "params")
+        _assert_trees_equal(cont.opt_state, r.opt_state, "opt_state")
+        assert r.iteration == cont.iteration == 8
+        assert r.epoch == cont.epoch == 2
+
+    def test_resume_total_epoch_budget(self, tmp_path):
+        """resume_from makes ``epochs`` a TOTAL budget: a run resumed after
+        its budget is already spent must be a no-op, not retrain."""
+        data = _data()
+        m = _mln()
+        m.set_listeners(CheckpointListener(
+            tmp_path, save_every_n_iterations=1, keep_all=True,
+            delete_existing=True))
+        m.fit(data, epochs=2, batch_size=16)
+        before = _leaves(m.params)
+        r = _mln(seed=99)
+        r.fit(data, epochs=2, batch_size=16, resume_from=tmp_path)
+        for u, v in zip(before, _leaves(r.params)):
+            np.testing.assert_array_equal(u, v)
+        assert r.iteration == m.iteration
+
+    def test_cg_resume_bit_exact(self, tmp_path):
+        data = _data(64)
+        cont = _cg()
+        cont.fit(data, epochs=2, batch_size=16)
+
+        m = _cg()
+        _fit_with_preemption(m, data, tmp_path, at_iter=6)
+
+        r = _cg(seed=99)
+        r.fit(data, epochs=2, batch_size=16, resume_from=tmp_path)
+        _assert_trees_equal(cont.params, r.params, "params")
+        _assert_trees_equal(cont.opt_state, r.opt_state, "opt_state")
+        assert r.iteration == cont.iteration == 8
+
+    @pytest.mark.parametrize("kw", [
+        {},
+        {"grad_compress": True},
+        {"sharded_update": True},
+        {"grad_compress": True, "sharded_update": True},
+    ], ids=["vanilla", "compress", "sharded", "both"])
+    def test_parallel_wrapper_resume_parity(self, tmp_path, kw):
+        """DP resume parity across the PR-3 exchange variants. The compress
+        configs only pass if the per-replica error-feedback residuals were
+        checkpointed and restored; sharded_update only if the opt state was
+        snapshotted out of the flat [R, m] exchange layout."""
+        mesh = make_mesh(MeshSpec(data=8))
+        data = _data(64)
+
+        cont = _mln()
+        ParallelWrapper(cont, mesh=mesh, **kw).fit(data, epochs=2, batch_size=16)
+
+        m = _mln()
+        m.set_listeners(CheckpointListener(
+            tmp_path, save_every_n_iterations=2, keep_all=True,
+            delete_existing=True))
+        install_chaos("preempt@iter:6")
+        with pytest.raises(ChaosPreemption):
+            ParallelWrapper(m, mesh=mesh, **kw).fit(data, epochs=2, batch_size=16)
+        install_chaos(None)
+
+        r = _mln(seed=99)
+        ParallelWrapper(r, mesh=mesh, **kw).fit(
+            data, epochs=2, batch_size=16, resume_from=tmp_path)
+        _assert_trees_equal(cont.params, r.params, "params")
+        _assert_trees_equal(cont.opt_state, r.opt_state, "opt_state")
+        assert r.iteration == cont.iteration == 8
+
+
+# ---------------------------------------------------------------------------
+# Divergence guard
+# ---------------------------------------------------------------------------
+
+
+def _guard_counts():
+    return dict(bucketing.telemetry().snapshot().get("guard", {}))
+
+
+class TestDivergenceGuard:
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            DivergenceGuard(policy="panic")
+        with pytest.raises(ValueError):
+            DivergenceGuard(policy="rollback")  # needs checkpoint_dir
+
+    def test_skip_batch_discards_bad_update_on_device(self):
+        """A NaN-poisoned batch must leave params/opt-state EXACTLY as they
+        were before that step (the on-device select), and training continues
+        finite afterwards."""
+        x, y = _data(64)
+        m = _mln()
+        m.set_divergence_guard(DivergenceGuard(policy="skip_batch", flush_every=4))
+        install_chaos("nan_grad@iter:2")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m.fit((x, y), epochs=2, batch_size=16)
+        for a in _leaves(m.params):
+            assert np.isfinite(a).all()
+        for a in _leaves(m.opt_state):
+            assert np.isfinite(a).all()
+        assert _guard_counts().get("skip_batch", 0) >= 1
+
+    def test_warn_policy_counts_but_does_not_touch_params(self):
+        x, y = _data()
+        m = _mln()
+        g = DivergenceGuard(policy="warn", flush_every=2)
+        m.set_divergence_guard(g)
+        install_chaos("nan_grad@iter:1")
+        with pytest.warns(UserWarning, match="DivergenceGuard"):
+            m.fit((x, y), epochs=1, batch_size=16)
+        assert g.trips >= 1
+        # warn leaves the poisoned update in place: params went NaN
+        assert any(not np.isfinite(a).all() for a in _leaves(m.params))
+
+    def test_rollback_restores_and_backs_off_lr(self, tmp_path):
+        x, y = _data(64)
+        m = _mln()
+        m.set_listeners(CheckpointListener(
+            tmp_path, save_every_n_iterations=2, keep_all=True,
+            delete_existing=True))
+        g = DivergenceGuard(policy="rollback", checkpoint_dir=tmp_path,
+                            lr_backoff=0.5, max_retries=3)
+        m.set_divergence_guard(g)
+        install_chaos("nan_grad@iter:5")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m.fit((x, y), epochs=2, batch_size=16)
+        assert g.retries == 1
+        assert m._lr_scale == pytest.approx(0.5)
+        for a in _leaves(m.params):
+            assert np.isfinite(a).all()
+        counts = _guard_counts()
+        assert counts.get("rollback", 0) >= 1
+        assert counts.get("rollback_restore", 0) >= 1
+
+    def test_rollback_exhausted_raises(self, tmp_path):
+        x, y = _data()
+        m = _mln()
+        g = DivergenceGuard(policy="rollback", checkpoint_dir=tmp_path,
+                            max_retries=0)
+        m.set_divergence_guard(g)
+        install_chaos("nan_grad@iter:1")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with pytest.raises(DivergenceError):
+                m.fit((x, y), epochs=1, batch_size=16)
+
+    def test_spike_limit_trips_on_finite_loss(self):
+        x, y = _data()
+        m = _mln()
+        g = DivergenceGuard(policy="warn", spike_limit=1e-6, flush_every=1)
+        m.set_divergence_guard(g)
+        with pytest.warns(UserWarning, match="DivergenceGuard"):
+            m.fit((x, y), epochs=1, batch_size=16)
+        assert g.trips >= 1  # softmax CE on random data >> 1e-6
+
+    def test_note_score_warns_once_and_counts(self):
+        resilience._INVALID_SCORE_WARNED = False
+        before = _guard_counts().get("invalid_score", 0)
+        with pytest.warns(UserWarning, match="non-finite"):
+            resilience.note_score(float("nan"))
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # second call must NOT warn
+            resilience.note_score(float("inf"))
+            resilience.note_score(1.25)  # finite: no count, no warn
+        assert _guard_counts().get("invalid_score", 0) == before + 2
