@@ -846,6 +846,78 @@ def bench_checkpoint():
     }
 
 
+def bench_mnist_mlp():
+    """Observability-overhead arm (ISSUE 5 gate: <= 2%): the SAME compiled
+    MNIST-shape MLP fit loop with the full obs layer live (spans + registry
+    + JSONL event log) vs DL4J_TPU_OBS=0. The env knob is read per call, so
+    both arms share one process, one model and one executable — the delta
+    is the layer itself, not compile or allocator noise."""
+    import shutil
+    import tempfile
+
+    from deeplearning4j_tpu import obs
+    from deeplearning4j_tpu.nn.input_type import InputType
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.model import (
+        MultiLayerConfiguration, MultiLayerNetwork)
+
+    n_feat, hidden, classes, batch = 784, (32 if SMOKE else 256), 10, 128
+    n_batches = 4 if SMOKE else 64
+    epochs = 1 if SMOKE else 3
+    conf = MultiLayerConfiguration(
+        layers=(Dense(n_out=hidden, activation="relu"),
+                OutputLayer(n_out=classes, activation="softmax")),
+        input_type=InputType.feed_forward(n_feat),
+        updater={"type": "sgd", "lr": 0.05},
+        seed=7,
+    )
+    model = MultiLayerNetwork(conf).init()
+    rs = np.random.RandomState(0)
+    n = batch * n_batches
+    X = rs.rand(n, n_feat).astype(np.float32)
+    Y = np.eye(classes, dtype=np.float32)[rs.randint(0, classes, n)]
+
+    workdir = tempfile.mkdtemp(prefix="bench_obs_")
+    old = os.environ.get("DL4J_TPU_OBS")
+
+    def arm(on: bool) -> float:
+        os.environ["DL4J_TPU_OBS"] = "1" if on else "0"
+        t0 = time.perf_counter()
+        model.fit((X, Y), epochs=epochs, batch_size=batch)
+        return time.perf_counter() - t0
+
+    try:
+        obs.configure_event_log(os.path.join(workdir, "events.jsonl"))
+        arm(True)    # warmup: compiles + first-touch of span/event paths
+        arm(False)
+        on_times, off_times = [], []
+        for _ in range(1 if SMOKE else 3):
+            off_times.append(arm(False))
+            on_times.append(arm(True))
+            if _budget_left() <= 0:
+                break
+    finally:
+        if old is None:
+            os.environ.pop("DL4J_TPU_OBS", None)
+        else:
+            os.environ["DL4J_TPU_OBS"] = old
+        obs.configure_event_log(None)
+        shutil.rmtree(workdir, ignore_errors=True)
+    t_on = sorted(on_times)[len(on_times) // 2]
+    t_off = sorted(off_times)[len(off_times) // 2]
+    overhead = (t_on - t_off) / t_off
+    steps = epochs * n_batches
+    return {
+        "metric": "mnist_mlp_obs_overhead",
+        "value": round(100.0 * overhead, 2),
+        "unit": "% fit wall-time, obs on vs DL4J_TPU_OBS=0 (gate: <= 2%)",
+        "obs_on_samples_per_sec": round(steps * batch / t_on, 1),
+        "obs_off_samples_per_sec": round(steps * batch / t_off, 1),
+        "reps": len(on_times),
+        "batches_per_arm": steps,
+    }
+
+
 _BENCHES = {
     "lenet5": bench_lenet5,
     "resnet50": bench_resnet50,
@@ -855,6 +927,7 @@ _BENCHES = {
     "serving": bench_serving_mixed,
     "dp_comms": bench_dp_comms,
     "checkpoint": bench_checkpoint,
+    "mnist_mlp": bench_mnist_mlp,
 }
 
 # benches that need a multi-device mesh regardless of the host's accelerator
@@ -916,10 +989,19 @@ def main():
 
     enable_compilation_cache_from_env()
 
+    # every result JSON carries the observability snapshot of the process
+    # that MEASURED it (per-bench subprocesses: their own registry/spans)
+    def _with_obs(m: dict) -> dict:
+        from deeplearning4j_tpu import obs
+
+        if "obs" not in m:
+            m["obs"] = obs.snapshot()
+        return m
+
     if args.only:
         _budget_start()
         try:
-            print(json.dumps(_BENCHES[args.only]()), flush=True)
+            print(json.dumps(_with_obs(_BENCHES[args.only]())), flush=True)
         except Exception as e:
             print(json.dumps({"metric": args.only,
                               "error": f"{type(e).__name__}: {e}"[:300]}))
@@ -930,7 +1012,7 @@ def main():
         if args.in_process or SMOKE:
             _budget_start()
             try:
-                m = fn()
+                m = _with_obs(fn())
             except Exception as e:
                 m = {"metric": name, "error": f"{type(e).__name__}: {e}"[:300]}
         else:

@@ -101,7 +101,11 @@ def check(site: str, hits_site: Optional[str] = None,
     ok = predicted is None or compiles <= predicted + extra_allowed
     report = GuardReport(site, compiles, predicted, ok)
     if not ok:
+        from deeplearning4j_tpu import obs
+
         buckets = tel.buckets_used(hits_site or site)
+        obs.event("retrace_guard", site=site, compiles=compiles,
+                  predicted=predicted, buckets=sorted(buckets))
         msg = (
             f"retrace guard: site '{site}' compiled {compiles}x but its "
             f"traffic used only {predicted} bucket(s) {list(buckets)}"

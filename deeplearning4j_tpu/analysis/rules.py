@@ -7,6 +7,7 @@
 | jit-purity        | traced                | value baked at trace time / silent   |
 | numpy-on-tracer   | traced                | TracerArrayConversionError / consts  |
 | lock-discipline   | threaded modules      | unguarded shared mutable state       |
+| monotonic-clock   | everything            | wall clock in duration arithmetic    |
 
 Each checker yields ``engine.Finding`` objects; inline
 ``# graftlint: disable=<rule>`` suppressions are honored by
@@ -36,6 +37,7 @@ ALL_RULES = (
     "jit-purity",
     "numpy-on-tracer",
     "lock-discipline",
+    "monotonic-clock",
 )
 
 # numpy calls that only touch metadata — safe on tracers and device arrays
@@ -67,6 +69,8 @@ def run(index: Index, rules: Optional[Sequence[str]] = None) -> List[Finding]:
         out += _rule_numpy_on_tracer(index)
     if "lock-discipline" in active:
         out += _rule_lock_discipline(index)
+    if "monotonic-clock" in active:
+        out += _rule_monotonic_clock(index)
     # drop duplicates (one line can trip a rule through several sub-checks)
     seen: Set[tuple] = set()
     uniq = []
@@ -360,6 +364,66 @@ def _rule_numpy_on_tracer(index: Index) -> List[Finding]:
                     f"np.{tail} applied to a traced value: numpy either "
                     "raises TracerArrayConversionError or silently constant-"
                     "folds at trace time; use jnp instead")
+                if f:
+                    out.append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# monotonic-clock
+# ---------------------------------------------------------------------------
+
+
+_WALL_CLOCKS = {"time.time", "time.time_ns"}
+
+
+def _rule_monotonic_clock(index: Index) -> List[Finding]:
+    """Wall clock in duration/deadline arithmetic: ``time.time()`` (or a name
+    assigned from it) fed into +/- or an ordering comparison. The wall clock
+    steps under NTP slew/adjustment — elapsed-time math wants
+    ``time.monotonic()`` or ``time.perf_counter()``. Value-only uses
+    (timestamps recorded into logs/indices) are not flagged."""
+    out = []
+    for q in sorted(index.functions):
+        fi = index.functions[q]
+        if isinstance(fi.node, ast.Module):
+            continue
+        sm = fi.module
+        nodes = own_nodes(fi.node)
+
+        wall_names: Set[str] = set()
+        for node in nodes:
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and dotted_name(node.value.func, sm) in _WALL_CLOCKS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        wall_names.add(t.id)
+
+        def is_wall(expr: ast.AST) -> bool:
+            if isinstance(expr, ast.Call) \
+                    and dotted_name(expr.func, sm) in _WALL_CLOCKS:
+                return True
+            return isinstance(expr, ast.Name) and expr.id in wall_names
+
+        for node in nodes:
+            hit = False
+            if isinstance(node, ast.BinOp) \
+                    and isinstance(node.op, (ast.Add, ast.Sub)) \
+                    and (is_wall(node.left) or is_wall(node.right)):
+                hit = True
+            elif isinstance(node, ast.Compare) and any(
+                    isinstance(op, (ast.Lt, ast.LtE, ast.Gt, ast.GtE))
+                    for op in node.ops) \
+                    and (is_wall(node.left)
+                         or any(is_wall(c) for c in node.comparators)):
+                hit = True
+            if hit:
+                f = index.make_finding(
+                    "monotonic-clock", fi, node.lineno,
+                    "time.time() in duration/deadline arithmetic: the wall "
+                    "clock steps under NTP adjustment — use time.monotonic() "
+                    "or time.perf_counter() for elapsed time")
                 if f:
                     out.append(f)
     return out

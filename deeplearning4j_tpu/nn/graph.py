@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu import obs
 from deeplearning4j_tpu.analysis import retrace_guard
 from deeplearning4j_tpu.nn.config import LayerConfig, layer_from_dict, _encode_value
 from deeplearning4j_tpu.nn.input_type import InputType
@@ -1076,6 +1077,7 @@ class ComputationGraph:
         and the interrupted epoch skips its already-consumed batches (same
         contract as MultiLayerNetwork.fit; docs/ROBUSTNESS.md)."""
         from deeplearning4j_tpu.train import resilience
+        from deeplearning4j_tpu.train.listeners import close_listeners
 
         if self.params is None:
             self.init()
@@ -1085,97 +1087,109 @@ class ComputationGraph:
                 resume_skip = int(getattr(self, "batch_in_epoch", 0))
                 epochs = max(epochs - self.epoch, 0)
         guard = getattr(self, "divergence_guard", None)
-        for _ in range(epochs):
-            skip_n, resume_skip = resume_skip, 0
-            self.batch_in_epoch = skip_n
-            for l in self.listeners:
-                l.on_epoch_start(self, self.epoch)
-            source = data() if callable(data) else data
-            tbptt = (self.conf.backprop_type == "tbptt"
-                     and bool(self._time_distributed_inputs()))
-            chain_k = (self._chain_k()
-                       if not (self.listeners or tbptt) and guard is None
-                       else 0)
-            buf: list = []
-            # pad every batch (incl. the partial tail) to ONE row count with
-            # a uniform ew/lmask calling convention → one compiled step
-            # (mirrors MultiLayerNetwork.fit); the chained path needs bare
-            # (f, l) batches, so it opts out
-            pad_target = (self._fit_pad_target_multi(source, batch_size)
-                          if chain_k <= 1 and not tbptt
-                          and bucketing.bucketing_enabled() else None)
+        try:
+            for _ in range(epochs):
+                skip_n, resume_skip = resume_skip, 0
+                self.batch_in_epoch = skip_n
+                for l in self.listeners:
+                    l.on_epoch_start(self, self.epoch)
+                source = data() if callable(data) else data
+                tbptt = (self.conf.backprop_type == "tbptt"
+                         and bool(self._time_distributed_inputs()))
+                chain_k = (self._chain_k()
+                           if not (self.listeners or tbptt) and guard is None
+                           else 0)
+                buf: list = []
+                # pad every batch (incl. the partial tail) to ONE row count
+                # with a uniform ew/lmask calling convention → one compiled
+                # step (mirrors MultiLayerNetwork.fit); the chained path
+                # needs bare (f, l) batches, so it opts out
+                pad_target = (self._fit_pad_target_multi(source, batch_size)
+                              if chain_k <= 1 and not tbptt
+                              and bucketing.bucketing_enabled() else None)
 
-            def flush(full: bool):
-                # full K-groups go out as ONE dispatch; tails use the
-                # per-step path (a different K = a fresh compile)
-                if full and len(buf) > 1:
-                    self._fit_chained(buf)
-                else:
-                    for bf, bl in buf:
-                        self.fit_batch((bf, bl, None, None))
-                buf.clear()
-
-            def batches():
-                it = self._iter_multi(source, batch_size)
-                # resume: already-consumed batches of the interrupted epoch
-                # are skipped HERE, without touching the RNG (the restored
-                # key is already past them)
-                for _ in range(skip_n):
-                    if next(it, None) is None:
+                def flush(full: bool):
+                    # full K-groups go out as ONE dispatch; tails use the
+                    # per-step path (a different K = a fresh compile)
+                    if not buf:
                         return
-                for f, l, fm, lm in it:
-                    # real-row count taken HERE, before padding, so the fit
-                    # loop never syncs ew back from device to learn it
-                    n = len(f[0])
-                    if pad_target is not None:
-                        yield bucketing.pad_fit_multi(
-                            f, l, fm, lm, pad_target, site="cg.fit") + (n,)
-                    else:
-                        yield (f, l, fm, lm, None, n)
+                    with obs.span("cg.fit_batch", batches=len(buf)):
+                        if full and len(buf) > 1:
+                            self._fit_chained(buf)
+                        else:
+                            for bf, bl in buf:
+                                self.fit_batch((bf, bl, None, None))
+                    buf.clear()
 
-            stream = batches()
-            from deeplearning4j_tpu.nn.model import (
-                _batch_sig, _device_prefetch_enabled)
-            if _device_prefetch_enabled():
-                # overlap next batch's host→device transfer with this step's
-                # compute (double buffering); AFTER padding, which is host-side
-                from deeplearning4j_tpu.datasets.iterator import prefetch_to_device
+                def batches():
+                    it = self._iter_multi(source, batch_size)
+                    # resume: already-consumed batches of the interrupted
+                    # epoch are skipped HERE, without touching the RNG (the
+                    # restored key is already past them)
+                    for _ in range(skip_n):
+                        if next(it, None) is None:
+                            return
+                    for f, l, fm, lm in it:
+                        # real-row count taken HERE, before padding, so the
+                        # fit loop never syncs ew back from device to learn it
+                        n = len(f[0])
+                        if pad_target is not None:
+                            yield bucketing.pad_fit_multi(
+                                f, l, fm, lm, pad_target, site="cg.fit") + (n,)
+                        else:
+                            yield (f, l, fm, lm, None, n)
 
-                stream = prefetch_to_device(stream)
-            for f, l, fm, lm, ew, n_real in stream:
-                batch = (f, l, fm, lm)
-                chainable = (
-                    chain_k > 1 and fm is None and lm is None
-                    and l is not None and all(y is not None for y in l)
-                    and (not buf or _batch_sig(f + l)
-                         == _batch_sig(buf[0][0] + buf[0][1]))
-                )
-                if chainable:
-                    buf.append((f, l))
+                stream = batches()
+                from deeplearning4j_tpu.nn.model import (
+                    _batch_sig, _device_prefetch_enabled)
+                if _device_prefetch_enabled():
+                    # overlap next batch's host→device transfer with this
+                    # step's compute (double buffering); AFTER padding,
+                    # which is host-side
+                    from deeplearning4j_tpu.datasets.iterator import prefetch_to_device
+
+                    stream = prefetch_to_device(stream)
+                for f, l, fm, lm, ew, n_real in stream:
+                    batch = (f, l, fm, lm)
+                    chainable = (
+                        chain_k > 1 and fm is None and lm is None
+                        and l is not None and all(y is not None for y in l)
+                        and (not buf or _batch_sig(f + l)
+                             == _batch_sig(buf[0][0] + buf[0][1]))
+                    )
+                    if chainable:
+                        buf.append((f, l))
+                        self.batch_in_epoch += 1
+                        if len(buf) == chain_k:
+                            flush(True)
+                        continue
+                    flush(False)
+                    with obs.span("cg.fit_batch"):
+                        if tbptt:
+                            score = self._fit_tbptt(*batch)
+                        else:
+                            score = self.fit_batch(batch, ew=ew)
                     self.batch_in_epoch += 1
-                    if len(buf) == chain_k:
-                        flush(True)
-                    continue
+                    if guard is not None:
+                        guard.observe(self, score)
+                    if self.listeners:
+                        # n_real came from the pre-padding host side of the
+                        # stream
+                        score = float(score)  # graftlint: disable=host-sync
+                        resilience.note_score(score)
+                        for l in self.listeners:
+                            l.iteration_done(self, self.iteration, score, n_real)
                 flush(False)
-                if tbptt:
-                    score = self._fit_tbptt(*batch)
-                else:
-                    score = self.fit_batch(batch, ew=ew)
-                self.batch_in_epoch += 1
                 if guard is not None:
-                    guard.observe(self, score)
-                if self.listeners:
-                    # n_real came from the pre-padding host side of the stream
-                    score = float(score)  # graftlint: disable=host-sync
-                    resilience.note_score(score)
-                    for l in self.listeners:
-                        l.iteration_done(self, self.iteration, score, n_real)
-            flush(False)
-            if guard is not None:
-                guard.flush(self)
-            for l in self.listeners:
-                l.on_epoch_end(self, self.epoch)
-            self.epoch += 1
+                    guard.flush(self)
+                for l in self.listeners:
+                    l.on_epoch_end(self, self.epoch)
+                self.epoch += 1
+        finally:
+            # a run ending inside a ProfilerListener [start, stop) window
+            # (normally or via an exception/chaos preempt) must not leak an
+            # open jax.profiler trace
+            close_listeners(self.listeners)
         return self
 
     def _is_single_multibatch(self, data) -> bool:
@@ -1356,24 +1370,25 @@ class ComputationGraph:
 
             self._output_fn = jax.jit(fwd)
         n = feats[0].shape[0] if feats else 0
-        if (bucketing.bucketing_enabled() and n > 0
-                and not self._has_batch_vertices):
-            target = bucketing.bucket_size(n)
-            bucketing.telemetry().record_hit("cg.output", n, target)
-            if target > n:
-                feats = tuple(bucketing.pad_rows_zero(x, target) for x in feats)
-                if fm is not None:
-                    fm = tuple(bucketing.pad_rows_zero(m, target)
-                               if m is not None else None for m in fm)
-                outs = self._output_fn(self.params, self.state,
-                                       self._input_dict(feats),
-                                       self._mask_dict(fm))
-                outs = tuple(bucketing.unpad(o, n) for o in outs)
-                retrace_guard.check_if_enabled("cg.output")
-                return outs[0] if len(outs) == 1 else outs
-        outs = self._output_fn(self.params, self.state, self._input_dict(feats),
-                               self._mask_dict(fm))
-        retrace_guard.check_if_enabled("cg.output")
+        with obs.span("cg.output"):
+            if (bucketing.bucketing_enabled() and n > 0
+                    and not self._has_batch_vertices):
+                target = bucketing.bucket_size(n)
+                bucketing.telemetry().record_hit("cg.output", n, target)
+                if target > n:
+                    feats = tuple(bucketing.pad_rows_zero(x, target) for x in feats)
+                    if fm is not None:
+                        fm = tuple(bucketing.pad_rows_zero(m, target)
+                                   if m is not None else None for m in fm)
+                    outs = self._output_fn(self.params, self.state,
+                                           self._input_dict(feats),
+                                           self._mask_dict(fm))
+                    outs = tuple(bucketing.unpad(o, n) for o in outs)
+                    retrace_guard.check_if_enabled("cg.output")
+                    return outs[0] if len(outs) == 1 else outs
+            outs = self._output_fn(self.params, self.state, self._input_dict(feats),
+                                   self._mask_dict(fm))
+            retrace_guard.check_if_enabled("cg.output")
         return outs[0] if len(outs) == 1 else outs
 
     # -- streaming RNN inference (ComputationGraph.rnnTimeStep:2718) -------

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu import obs
 from deeplearning4j_tpu.analysis import retrace_guard
 from deeplearning4j_tpu.nn.config import LayerConfig, layer_from_dict, _encode_value
 from deeplearning4j_tpu.utils import bucketing
@@ -617,6 +618,7 @@ class MultiLayerNetwork:
         skips its already-consumed batches, so the resumed run replays the
         exact RNG/batch stream of an uninterrupted one (docs/ROBUSTNESS.md)."""
         from deeplearning4j_tpu.train import resilience
+        from deeplearning4j_tpu.train.listeners import close_listeners
 
         if self.params is None:
             self.init()
@@ -631,92 +633,104 @@ class MultiLayerNetwork:
         guard = getattr(self, "divergence_guard", None)
         chain_k = (self._chain_k()
                    if sgd and not self.listeners and guard is None else 0)
-        for _ in range(epochs):
-            skip_n, resume_skip = resume_skip, 0
-            self.batch_in_epoch = skip_n
-            for l in self.listeners:
-                l.on_epoch_start(self, self.epoch)
-            source = data() if callable(data) else data
-            buf: list = []
-            # pad every batch (incl. the partial tail) to ONE row count with
-            # a uniform ew/lmask calling convention → one compiled step. The
-            # chained path needs bare (x, y) batches, so it opts out.
-            pad_target = (_fit_pad_target(source, batch_size)
-                          if sgd and chain_k <= 1
-                          and bucketing.bucketing_enabled() else None)
+        try:
+            for _ in range(epochs):
+                skip_n, resume_skip = resume_skip, 0
+                self.batch_in_epoch = skip_n
+                for l in self.listeners:
+                    l.on_epoch_start(self, self.epoch)
+                source = data() if callable(data) else data
+                buf: list = []
+                # pad every batch (incl. the partial tail) to ONE row count
+                # with a uniform ew/lmask calling convention → one compiled
+                # step. The chained path needs bare (x, y) batches, so it
+                # opts out.
+                pad_target = (_fit_pad_target(source, batch_size)
+                              if sgd and chain_k <= 1
+                              and bucketing.bucketing_enabled() else None)
 
-            def flush(full: bool):
-                # full K-groups go out as ONE dispatch; tails use the
-                # per-step path (a different K would be a fresh compile)
-                if full and len(buf) > 1:
-                    self._fit_chained(buf)
-                else:
-                    for bx, by in buf:
-                        self._fit_batch(bx, by, None, None)
-                buf.clear()
-
-            def batches():
-                it = _iter_batches(source, batch_size)
-                # resume: the interrupted epoch's consumed batches are
-                # skipped HERE, before padding/prefetch and without touching
-                # the RNG — the restored key is already past them
-                for _ in range(skip_n):
-                    if next(it, None) is None:
+                def flush(full: bool):
+                    # full K-groups go out as ONE dispatch; tails use the
+                    # per-step path (a different K would be a fresh compile)
+                    if not buf:
                         return
-                for x, y, fm, lm in it:
-                    # real-row count taken HERE, before padding, so the fit
-                    # loop never has to sync ew back from device to learn it
-                    n = len(x)
-                    if pad_target is not None and not (tbptt and np.ndim(x) == 3):
-                        yield bucketing.pad_fit_batch(
-                            x, y, fm, lm, pad_target, site="mln.fit") + (n,)
-                    else:
-                        yield (x, y, fm, lm, None, n)
+                    with obs.span("mln.fit_batch", batches=len(buf)):
+                        if full and len(buf) > 1:
+                            self._fit_chained(buf)
+                        else:
+                            for bx, by in buf:
+                                self._fit_batch(bx, by, None, None)
+                    buf.clear()
 
-            stream = batches()
-            if sgd and _device_prefetch_enabled():
-                # overlap next batch's host→device transfer with this step's
-                # compute (double buffering); AFTER padding, which is host-side
-                from deeplearning4j_tpu.datasets.iterator import prefetch_to_device
+                def batches():
+                    it = _iter_batches(source, batch_size)
+                    # resume: the interrupted epoch's consumed batches are
+                    # skipped HERE, before padding/prefetch and without
+                    # touching the RNG — the restored key is already past them
+                    for _ in range(skip_n):
+                        if next(it, None) is None:
+                            return
+                    for x, y, fm, lm in it:
+                        # real-row count taken HERE, before padding, so the
+                        # fit loop never syncs ew back from device to learn it
+                        n = len(x)
+                        if pad_target is not None and not (tbptt and np.ndim(x) == 3):
+                            yield bucketing.pad_fit_batch(
+                                x, y, fm, lm, pad_target, site="mln.fit") + (n,)
+                        else:
+                            yield (x, y, fm, lm, None, n)
 
-                stream = prefetch_to_device(stream)
-            for x, y, fm, lm, ew, n_real in stream:
-                chainable = (
-                    chain_k > 1 and fm is None and lm is None
-                    and not (tbptt and np.ndim(x) == 3)
-                    and (not buf or _batch_sig((x, y))
-                         == _batch_sig((buf[0][0], buf[0][1])))
-                )
-                if chainable:
-                    buf.append((x, y))
+                stream = batches()
+                if sgd and _device_prefetch_enabled():
+                    # overlap next batch's host→device transfer with this
+                    # step's compute (double buffering); AFTER padding,
+                    # which is host-side
+                    from deeplearning4j_tpu.datasets.iterator import prefetch_to_device
+
+                    stream = prefetch_to_device(stream)
+                for x, y, fm, lm, ew, n_real in stream:
+                    chainable = (
+                        chain_k > 1 and fm is None and lm is None
+                        and not (tbptt and np.ndim(x) == 3)
+                        and (not buf or _batch_sig((x, y))
+                             == _batch_sig((buf[0][0], buf[0][1])))
+                    )
+                    if chainable:
+                        buf.append((x, y))
+                        self.batch_in_epoch += 1
+                        if len(buf) == chain_k:
+                            flush(True)
+                        continue
+                    flush(False)
+                    with obs.span("mln.fit_batch"):
+                        if not sgd:
+                            score = self._fit_solver(x, y, fm, lm)
+                        elif tbptt and np.ndim(x) == 3:
+                            score = self._fit_tbptt(x, y, fm, lm)
+                        else:
+                            score = self._fit_batch(x, y, fm, lm, ew=ew)
                     self.batch_in_epoch += 1
-                    if len(buf) == chain_k:
-                        flush(True)
-                    continue
+                    if guard is not None:
+                        guard.observe(self, score)
+                    # score is a device scalar; only sync the host when a
+                    # listener actually consumes it (keeps dispatch async);
+                    # n_real came from the pre-padding host side of the stream
+                    if self.listeners:
+                        score = float(score)  # graftlint: disable=host-sync
+                        resilience.note_score(score)
+                        for l in self.listeners:
+                            l.iteration_done(self, self.iteration, score, n_real)
                 flush(False)
-                if not sgd:
-                    score = self._fit_solver(x, y, fm, lm)
-                elif tbptt and np.ndim(x) == 3:
-                    score = self._fit_tbptt(x, y, fm, lm)
-                else:
-                    score = self._fit_batch(x, y, fm, lm, ew=ew)
-                self.batch_in_epoch += 1
                 if guard is not None:
-                    guard.observe(self, score)
-                # score is a device scalar; only sync the host when a
-                # listener actually consumes it (keeps dispatch async);
-                # n_real came from the pre-padding host side of the stream
-                if self.listeners:
-                    score = float(score)  # graftlint: disable=host-sync
-                    resilience.note_score(score)
-                    for l in self.listeners:
-                        l.iteration_done(self, self.iteration, score, n_real)
-            flush(False)
-            if guard is not None:
-                guard.flush(self)
-            for l in self.listeners:
-                l.on_epoch_end(self, self.epoch)
-            self.epoch += 1
+                    guard.flush(self)
+                for l in self.listeners:
+                    l.on_epoch_end(self, self.epoch)
+                self.epoch += 1
+        finally:
+            # a run ending inside a ProfilerListener [start, stop) window
+            # (normally or via an exception/chaos preempt) must not leak an
+            # open jax.profiler trace
+            close_listeners(self.listeners)
         return self
 
     def _fit_batch(self, x, y, fm, lm, ew=None):
@@ -825,18 +839,19 @@ class MultiLayerNetwork:
         x = _cast_input(x, self.dtype)
         fmask = jnp.asarray(fmask, self.dtype) if fmask is not None else None
         n = x.shape[0]
-        if bucketing.bucketing_enabled() and n > 0:
-            target = bucketing.bucket_size(n)
-            bucketing.telemetry().record_hit("mln.output", n, target)
-            if target > n:
-                x = bucketing.pad_rows_zero(x, target)
-                fmask = bucketing.pad_rows_zero(fmask, target)
-                out = bucketing.unpad(
-                    self._output_fn(self.params, self.state, x, fmask), n)
-                retrace_guard.check_if_enabled("mln.output")
-                return out
-        out = self._output_fn(self.params, self.state, x, fmask)
-        retrace_guard.check_if_enabled("mln.output")
+        with obs.span("mln.output"):
+            if bucketing.bucketing_enabled() and n > 0:
+                target = bucketing.bucket_size(n)
+                bucketing.telemetry().record_hit("mln.output", n, target)
+                if target > n:
+                    x = bucketing.pad_rows_zero(x, target)
+                    fmask = bucketing.pad_rows_zero(fmask, target)
+                    out = bucketing.unpad(
+                        self._output_fn(self.params, self.state, x, fmask), n)
+                    retrace_guard.check_if_enabled("mln.output")
+                    return out
+            out = self._output_fn(self.params, self.state, x, fmask)
+            retrace_guard.check_if_enabled("mln.output")
         return out
 
     def predict(self, x) -> np.ndarray:

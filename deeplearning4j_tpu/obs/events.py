@@ -1,0 +1,144 @@
+"""Structured JSONL event log with rotation and never-crash discipline.
+
+One line per event::
+
+    {"ts": 1754400000.123, "kind": "checkpoint_saved", "path": "...", ...}
+
+``ts`` is intentionally wall-clock (log lines are correlated with external
+systems); all DURATION fields are computed by callers from monotonic clocks.
+Telemetry must never take training down — same discipline as
+``ui/storage.py``'s remote router: serialization falls back to ``str()``,
+any I/O error drops the event (counted in ``dl4j_events_dropped_total``)
+and the log keeps running.
+
+Rotation: when the active file exceeds ``max_bytes`` it is renamed to
+``<path>.1`` (replacing any previous rollover) and a fresh file is started,
+bounding disk use at ~2x ``max_bytes``.
+
+Enabling: ``obs.configure_event_log(path)`` explicitly, or set
+``DL4J_TPU_EVENT_LOG=<path>`` before the first event (checked lazily per
+emit, per the repo's read-env-per-call convention). Every event also
+increments ``dl4j_events_total{kind=...}`` whether or not a file sink is
+configured, so event counts are scrapeable at /metrics regardless.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from deeplearning4j_tpu.obs import metrics
+
+__all__ = ["EventLog", "event_log"]
+
+_DEFAULT_MAX_BYTES = 4 * 1024 * 1024
+
+
+class EventLog:
+    def __init__(self, reg: Optional[metrics.MetricsRegistry] = None):
+        self._reg = reg or metrics.registry()
+        self._counts = self._reg.counter(
+            "dl4j_events_total", "structured events by kind", ("kind",))
+        self._dropped = self._reg.counter(
+            "dl4j_events_dropped_total",
+            "events lost to serialization/I-O errors (never-crash discipline)")
+        self._lock = threading.Lock()
+        self._path: Optional[str] = None
+        self._max_bytes = _DEFAULT_MAX_BYTES
+        self._size = 0
+        self._env_checked = False
+
+    # -- configuration -----------------------------------------------------
+
+    def configure(self, path: Optional[str], max_bytes: int = _DEFAULT_MAX_BYTES):
+        """Point the file sink at ``path`` (None disables it). Counting via
+        the registry continues either way."""
+        with self._lock:
+            self._path = str(path) if path else None
+            self._max_bytes = max(1024, int(max_bytes))
+            self._size = self._current_size()
+            self._env_checked = True  # explicit config wins over the env knob
+
+    def _current_size(self) -> int:
+        if not self._path:
+            return 0
+        try:
+            return os.path.getsize(self._path)
+        except OSError:
+            return 0
+
+    def _maybe_adopt_env(self):
+        # lazy: picked up on first emit so subprocesses (bench isolation,
+        # chaos smoke) inherit the knob without an explicit configure call
+        if self._env_checked:
+            return
+        self._env_checked = True
+        path = os.environ.get("DL4J_TPU_EVENT_LOG")
+        if path:
+            self._path = path
+            try:
+                mb = int(os.environ.get("DL4J_TPU_EVENT_LOG_MAX_BYTES", "0"))
+            except ValueError:
+                mb = 0
+            if mb > 0:
+                self._max_bytes = max(1024, mb)
+            self._size = self._current_size()
+
+    @property
+    def path(self) -> Optional[str]:
+        with self._lock:
+            self._maybe_adopt_env()
+            return self._path
+
+    # -- emission ----------------------------------------------------------
+
+    def emit(self, kind: str, **fields):
+        """Record one event. Never raises."""
+        try:
+            self._counts.inc(kind=kind)
+            with self._lock:
+                self._maybe_adopt_env()
+                if not self._path:
+                    return
+                rec = {"ts": time.time(), "kind": kind}  # graftlint: disable=jit-purity
+                rec.update(fields)
+                try:
+                    line = json.dumps(rec, default=str)
+                except (TypeError, ValueError):
+                    line = json.dumps({"ts": rec["ts"], "kind": kind,
+                                       "error": "unserializable-event"})
+                data = line + "\n"
+                if self._size + len(data) > self._max_bytes:
+                    self._rotate()
+                with open(self._path, "a", encoding="utf-8") as fh:
+                    fh.write(data)
+                self._size += len(data)
+        except Exception:
+            try:
+                self._dropped.inc()
+            except Exception:
+                pass
+
+    def _rotate(self):
+        # caller holds the lock
+        try:
+            os.replace(self._path, self._path + ".1")
+        except OSError:
+            pass
+        self._size = 0
+
+    # -- views -------------------------------------------------------------
+
+    def counts(self) -> dict:
+        """{kind: count} since process start (or last obs.reset())."""
+        return {k[0]: v for k, v in self._counts.as_dict().items()}
+
+
+_LOG = EventLog()
+
+
+def event_log() -> EventLog:
+    return _LOG

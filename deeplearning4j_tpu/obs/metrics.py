@@ -1,0 +1,277 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+Design constraints (ISSUE 5 / the hot-path discipline the rest of the repo
+already follows):
+
+- **Host-side scalars only.** Nothing here touches jax; callers must never
+  pass device arrays. Recording is a dict update under a lock — cheap enough
+  for per-iteration use. ``block_until_ready`` is never called on the hot
+  path; if a value needs a device sync to exist, it is not a metric.
+- **Label families.** A metric name plus a fixed tuple of label names forms
+  a family; each distinct label-value combination is one series. This is the
+  Prometheus data model, so exposition is a straight rendering.
+- **Bounded memory.** Histograms keep (count, sum, min, max) exactly and a
+  bounded reservoir of recent observations for quantiles; series counts are
+  bounded by the code's own label cardinality (sites, buckets, event kinds).
+
+The registry is process-global (``registry()``); ``bucketing.telemetry()``
+is an adapter shim over families registered here (utils/bucketing.py), so
+every counter that existed before this layer is scrapeable at /metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "registry",
+]
+
+_RESERVOIR = 256  # recent observations kept per histogram series
+
+
+def _label_key(label_names: Tuple[str, ...], labels: Dict[str, object]) -> Tuple[str, ...]:
+    if set(labels) != set(label_names):
+        raise ValueError(
+            f"expected labels {label_names}, got {tuple(sorted(labels))}")
+    return tuple(str(labels[k]) for k in label_names)
+
+
+class _Family:
+    """Shared series bookkeeping for one named metric family."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, label_names: Sequence[str]):
+        self.name = name
+        self.help = help
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple[str, ...], object] = {}
+
+    def clear(self):
+        """Drop every series (tests / bench isolation)."""
+        with self._lock:
+            self._series.clear()
+
+    def series(self) -> List[Tuple[Tuple[str, ...], object]]:
+        with self._lock:
+            return sorted(self._series.items())
+
+
+class Counter(_Family):
+    """Monotonically increasing count. ``inc`` returns the new value so
+    callers can detect first-touch (e.g. bucket promotion) in one step."""
+
+    kind = "counter"
+
+    def inc(self, amount: float = 1, **labels) -> float:
+        key = _label_key(self.label_names, labels)
+        with self._lock:
+            v = self._series.get(key, 0) + amount
+            self._series[key] = v
+            return v
+
+    def value(self, **labels) -> float:
+        key = _label_key(self.label_names, labels)
+        with self._lock:
+            return self._series.get(key, 0)
+
+    def as_dict(self) -> Dict[Tuple[str, ...], float]:
+        with self._lock:
+            return dict(self._series)
+
+
+class Gauge(_Family):
+    """Last-write-wins scalar (configuration values, current score, ...)."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels):
+        key = _label_key(self.label_names, labels)
+        with self._lock:
+            self._series[key] = value
+
+    def value(self, **labels) -> Optional[float]:
+        key = _label_key(self.label_names, labels)
+        with self._lock:
+            return self._series.get(key)
+
+    def as_dict(self) -> Dict[Tuple[str, ...], float]:
+        with self._lock:
+            return dict(self._series)
+
+
+class _HistSeries:
+    __slots__ = ("count", "total", "min", "max", "reservoir")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self.reservoir = deque(maxlen=_RESERVOIR)
+
+
+class Histogram(_Family):
+    """count/sum/min/max exactly + a bounded reservoir of the most recent
+    observations for approximate quantiles. Rendered as a Prometheus
+    summary (quantile series + _sum/_count)."""
+
+    kind = "histogram"
+
+    def observe(self, value: float, **labels):
+        key = _label_key(self.label_names, labels)
+        v = float(value)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = _HistSeries()
+            s.count += 1
+            s.total += v
+            if v < s.min:
+                s.min = v
+            if v > s.max:
+                s.max = v
+            s.reservoir.append(v)
+
+    def summary(self, **labels) -> Optional[dict]:
+        key = _label_key(self.label_names, labels)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                return None
+            return self._summarize(s)
+
+    @staticmethod
+    def _summarize(s: "_HistSeries") -> dict:
+        res = sorted(s.reservoir)
+        q = lambda p: res[min(len(res) - 1, int(p * len(res)))] if res else 0.0
+        return {
+            "count": s.count,
+            "sum": s.total,
+            "min": s.min if s.count else 0.0,
+            "max": s.max if s.count else 0.0,
+            "p50": q(0.50),
+            "p90": q(0.90),
+            "p99": q(0.99),
+        }
+
+    def as_dict(self) -> Dict[Tuple[str, ...], dict]:
+        with self._lock:
+            return {k: self._summarize(s) for k, s in self._series.items()}
+
+
+class MetricsRegistry:
+    """Name -> family map with get-or-create accessors. Re-registering a
+    name returns the existing family; a kind or label mismatch is a
+    programming error and raises."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+
+    def _get(self, cls, name: str, help: str, label_names: Sequence[str]):
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if not isinstance(fam, cls) or fam.label_names != tuple(label_names):
+                    raise ValueError(
+                        f"metric {name!r} already registered as {fam.kind} "
+                        f"with labels {fam.label_names}")
+                return fam
+            fam = cls(name, help, label_names)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help: str = "", label_names: Sequence[str] = ()) -> Counter:
+        return self._get(Counter, name, help, label_names)
+
+    def gauge(self, name: str, help: str = "", label_names: Sequence[str] = ()) -> Gauge:
+        return self._get(Gauge, name, help, label_names)
+
+    def histogram(self, name: str, help: str = "", label_names: Sequence[str] = ()) -> Histogram:
+        return self._get(Histogram, name, help, label_names)
+
+    def families(self) -> List[_Family]:
+        with self._lock:
+            return [self._families[n] for n in sorted(self._families)]
+
+    def reset(self):
+        """Clear every series but keep family registrations (shims hold
+        references to their families, so dropping them would orphan those)."""
+        for fam in self.families():
+            fam.clear()
+
+    # -- views -------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-friendly {name: {"label=value|...": value-or-summary}}."""
+        out: Dict[str, dict] = {}
+        for fam in self.families():
+            series = {}
+            for key, _ in fam.series():
+                labels = dict(zip(fam.label_names, key))
+                skey = "|".join(f"{k}={v}" for k, v in labels.items()) or ""
+                if isinstance(fam, Histogram):
+                    series[skey] = fam.summary(**labels)
+                elif isinstance(fam, (Counter, Gauge)):
+                    series[skey] = fam.value(**labels)
+            out[fam.name] = series
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines: List[str] = []
+        for fam in self.families():
+            if fam.help:
+                lines.append(f"# HELP {fam.name} {_esc_help(fam.help)}")
+            kind = "summary" if isinstance(fam, Histogram) else fam.kind
+            lines.append(f"# TYPE {fam.name} {kind}")
+            for key, _ in fam.series():
+                labels = dict(zip(fam.label_names, key))
+                if isinstance(fam, Histogram):
+                    s = fam.summary(**labels)
+                    for qname, qval in (("0.5", s["p50"]), ("0.9", s["p90"]),
+                                        ("0.99", s["p99"])):
+                        lines.append(_sample(fam.name, {**labels, "quantile": qname}, qval))
+                    lines.append(_sample(fam.name + "_sum", labels, s["sum"]))
+                    lines.append(_sample(fam.name + "_count", labels, s["count"]))
+                else:
+                    lines.append(_sample(fam.name, labels, fam.value(**labels)))
+        return "\n".join(lines) + "\n"
+
+
+def _esc_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _esc_label(s: str) -> str:
+    return s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _sample(name: str, labels: Dict[str, str], value) -> str:
+    if labels:
+        body = ",".join(f'{k}="{_esc_label(str(v))}"' for k, v in sorted(labels.items()))
+        return f"{name}{{{body}}} {_num(value)}"
+    return f"{name} {_num(value)}"
+
+
+def _num(v) -> str:
+    if v is None:
+        return "0"
+    f = float(v)
+    return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _REGISTRY

@@ -1,0 +1,178 @@
+"""Span tracer: host wall-time + dispatch-time windows around step-level work.
+
+``span("mln.fit_batch")`` wraps one unit of work. On exit it records
+
+- ``wall_s``  — host wall-clock window (``time.perf_counter`` delta). With
+  async dispatch this includes any time the host BLOCKED on the device
+  (donated-buffer back-pressure, explicit syncs in callers) but never forces
+  a sync itself — ``block_until_ready`` is deliberately absent here.
+- ``cpu_s``   — the dispatch-time window: CPU time this thread spent inside
+  the span (``time.thread_time`` delta). For a healthy async pipeline
+  ``cpu_s`` ≈ tracing/dispatch cost and ``wall_s`` ≫ ``cpu_s`` means the
+  host was waiting (device-bound or back-pressured) — the two windows
+  together locate the bottleneck without device instrumentation.
+
+Nesting is tracked per thread: a span opened while another is active records
+the outer span's name as ``parent`` and its own ``depth``. Finished spans go
+to a bounded ring buffer (most recent last) and into the
+``dl4j_span_seconds`` histogram family in the metrics registry.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from deeplearning4j_tpu.obs import metrics
+
+__all__ = ["SpanTracer", "tracer"]
+
+_RING = 512  # finished spans retained
+
+
+class _ActiveSpan:
+    __slots__ = ("name", "attrs", "t0", "c0")
+
+    def __init__(self, name: str, attrs: Dict[str, object]):
+        self.name = name
+        self.attrs = attrs
+        self.t0 = time.perf_counter()
+        self.c0 = time.thread_time()
+
+
+class _SpanContext:
+    """Context manager handed out by ``SpanTracer.span``. Re-entrant-safe in
+    the sense that each ``with`` creates a fresh context."""
+
+    __slots__ = ("_tracer", "_name", "_attrs", "_active")
+
+    def __init__(self, tracer: "SpanTracer", name: str, attrs: Dict[str, object]):
+        self._tracer = tracer
+        self._name = name
+        self._attrs = attrs
+        self._active: Optional[_ActiveSpan] = None
+
+    def __enter__(self):
+        self._active = self._tracer._push(self._name, self._attrs)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._tracer._pop(self._active, error=exc_type is not None)
+        return False
+
+
+class _NullContext:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+_NULL = _NullContext()
+
+
+class SpanTracer:
+    def __init__(self, reg: Optional[metrics.MetricsRegistry] = None):
+        self._reg = reg or metrics.registry()
+        self._hist = self._reg.histogram(
+            "dl4j_span_seconds",
+            "host wall-time of instrumented spans (see dl4j_span_cpu_seconds "
+            "for the dispatch-time window)", ("span",))
+        self._cpu = self._reg.histogram(
+            "dl4j_span_cpu_seconds",
+            "thread CPU time inside instrumented spans (dispatch cost; "
+            "wall >> cpu means the host was waiting)", ("span",))
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=_RING)
+        self._tls = threading.local()
+
+    # -- recording ---------------------------------------------------------
+
+    def span(self, name: str, **attrs) -> object:
+        """Context manager timing one unit of work. With observability
+        disabled (DL4J_TPU_OBS=0) returns a shared no-op context."""
+        from deeplearning4j_tpu import obs
+
+        if not obs.enabled():
+            return _NULL
+        return _SpanContext(self, name, attrs)
+
+    def _stack(self) -> List[_ActiveSpan]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _push(self, name: str, attrs: Dict[str, object]) -> _ActiveSpan:
+        sp = _ActiveSpan(name, attrs)
+        self._stack().append(sp)
+        return sp
+
+    def _pop(self, sp: Optional[_ActiveSpan], error: bool = False):
+        if sp is None:
+            return
+        wall = time.perf_counter() - sp.t0
+        cpu = time.thread_time() - sp.c0
+        stack = self._stack()
+        # tolerate exotic unwinds: pop through to OUR frame
+        while stack and stack[-1] is not sp:
+            stack.pop()
+        if stack:
+            stack.pop()
+        parent = stack[-1].name if stack else None
+        rec = {
+            "span": sp.name,
+            "wall_s": wall,
+            "cpu_s": cpu,
+            "parent": parent,
+            "depth": len(stack),
+        }
+        if error:
+            rec["error"] = True
+        if sp.attrs:
+            rec["attrs"] = sp.attrs
+        with self._lock:
+            self._ring.append(rec)
+        self._hist.observe(wall, span=sp.name)
+        self._cpu.observe(cpu, span=sp.name)
+
+    # -- views -------------------------------------------------------------
+
+    def recent(self, n: Optional[int] = None) -> List[dict]:
+        """Most recent finished spans, oldest first."""
+        with self._lock:
+            out = list(self._ring)
+        return out if n is None else out[-n:]
+
+    def summary(self) -> Dict[str, dict]:
+        """Per-span-name {count, wall_sum_s, wall_p50_s, wall_max_s, cpu_sum_s}
+        from the registry histograms (JSON-friendly, for ``obs.snapshot()``)."""
+        out: Dict[str, dict] = {}
+        for key, _ in self._hist.series():
+            name = key[0]
+            s = self._hist.summary(span=name)
+            c = self._cpu.summary(span=name)
+            out[name] = {
+                "count": s["count"],
+                "wall_sum_s": s["sum"],
+                "wall_p50_s": s["p50"],
+                "wall_max_s": s["max"],
+                "cpu_sum_s": c["sum"] if c else 0.0,
+            }
+        return out
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+
+
+_TRACER = SpanTracer()
+
+
+def tracer() -> SpanTracer:
+    return _TRACER

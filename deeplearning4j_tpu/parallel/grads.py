@@ -50,6 +50,7 @@ from jax import lax
 from jax.experimental.shard_map import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu import obs
 from deeplearning4j_tpu.analysis import retrace_guard
 from deeplearning4j_tpu.parallel import compress as compression
 from deeplearning4j_tpu.train.updaters import apply_gradient_normalization
@@ -588,11 +589,12 @@ class DataParallelStep:
         fm = jnp.asarray(fm, model.dtype) if fm is not None else None
         lm = jnp.asarray(lm, model.dtype) if lm is not None else None
         ew = jnp.asarray(ew, model.dtype) if ew is not None else None
-        (model.params, (self._opt_flat, self._residual), model.state,
-         _, loss) = self._step(
-            model.params, (self._opt_flat, self._residual), model.state,
-            jnp.asarray(model.iteration, jnp.int32), model._next_rng(),
-            x, y, fm, lm, (), ew)
+        with obs.span("dp.step"):
+            (model.params, (self._opt_flat, self._residual), model.state,
+             _, loss) = self._step(
+                model.params, (self._opt_flat, self._residual), model.state,
+                jnp.asarray(model.iteration, jnp.int32), model._next_rng(),
+                x, y, fm, lm, (), ew)
         model.iteration += 1
         retrace_guard.check_if_enabled("mln.step", hits_site="dp.fit",
                                        extra_allowed=1)
@@ -613,11 +615,12 @@ class DataParallelStep:
             chaos.maybe_slow(model.iteration)
             f = chaos.maybe_nan_batch(model.iteration, f)
         ew = jnp.asarray(ew, model.dtype) if ew is not None else None
-        (model.params, (self._opt_flat, self._residual), model.state,
-         _, loss) = self._step(
-            model.params, (self._opt_flat, self._residual), model.state,
-            jnp.asarray(model.iteration, jnp.int32), model._next_rng(),
-            model._input_dict(f), l, model._mask_dict(fm), lm, {}, ew)
+        with obs.span("dp.step"):
+            (model.params, (self._opt_flat, self._residual), model.state,
+             _, loss) = self._step(
+                model.params, (self._opt_flat, self._residual), model.state,
+                jnp.asarray(model.iteration, jnp.int32), model._next_rng(),
+                model._input_dict(f), l, model._mask_dict(fm), lm, {}, ew)
         model.iteration += 1
         retrace_guard.check_if_enabled("cg.step", hits_site="dp.fit",
                                        extra_allowed=1)

@@ -25,8 +25,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu import obs
 from deeplearning4j_tpu.nn.model import _iter_batches
 from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+from deeplearning4j_tpu.train.listeners import close_listeners
 from deeplearning4j_tpu.utils import bucketing
 from deeplearning4j_tpu.utils.bucketing import padded_label_mask, tile_pad
 
@@ -268,9 +270,10 @@ class ParallelWrapper:
                         ew[:n] = 1.0
                     args = (self._shard(x), self._shard(y), self._shard(fm),
                             self._shard(lm))
-                    score = (runner.fit_batch(*args, ew=self._shard(ew))
-                             if runner is not None
-                             else model._fit_batch(*args, ew=self._shard(ew)))
+                    with obs.span("dp.fit_batch"):
+                        score = (runner.fit_batch(*args, ew=self._shard(ew))
+                                 if runner is not None
+                                 else model._fit_batch(*args, ew=self._shard(ew)))
                     model.batch_in_epoch += 1
                     if guard is not None:
                         guard.observe(model, score)
@@ -292,6 +295,9 @@ class ParallelWrapper:
         finally:
             if runner is not None:
                 runner.finish()
+            # same teardown contract as model.fit: stop in-flight
+            # ProfilerListener traces even when the loop exits early
+            close_listeners(model.listeners)
         return model
 
     def _fit_graph(self, data, epochs: int, batch_size: Optional[int],
@@ -310,6 +316,7 @@ class ParallelWrapper:
         finally:
             if runner is not None:
                 runner.finish()
+            close_listeners(model.listeners)
         return model
 
     def _fit_graph_loop(self, data, epochs, batch_size, shard_t, runner,
@@ -373,9 +380,10 @@ class ParallelWrapper:
                     ew = np.zeros(total, np.float32)
                     ew[:n] = 1.0
                 sharded = (shard_t(f), shard_t(lbl), shard_t(fm), shard_t(lm))
-                score = (runner.fit_batch_graph(sharded, ew=self._shard(ew))
-                         if runner is not None
-                         else model.fit_batch(sharded, ew=self._shard(ew)))
+                with obs.span("dp.fit_batch"):
+                    score = (runner.fit_batch_graph(sharded, ew=self._shard(ew))
+                             if runner is not None
+                             else model.fit_batch(sharded, ew=self._shard(ew)))
                 model.batch_in_epoch += 1
                 if guard is not None:
                     guard.observe(model, score)

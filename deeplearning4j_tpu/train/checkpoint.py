@@ -197,12 +197,15 @@ class CheckpointListener(TrainingListener):
     def last_valid_checkpoint(directory) -> Optional[Checkpoint]:
         """Newest checkpoint whose file passes CRC/size (or structural)
         validation — corrupt or truncated files fall through to older ones."""
+        from deeplearning4j_tpu import obs
         from deeplearning4j_tpu.train import resilience
 
         for c in reversed(CheckpointListener.checkpoints(directory)):
             path = os.path.join(str(directory), c.filename)
             if resilience.validate_checkpoint(path, crc=c.crc, size=c.size):
                 return c
+            obs.event("checkpoint_corrupt_fallback", path=path,
+                      number=c.number)
         return None
 
     @staticmethod

@@ -96,10 +96,11 @@ class MaxTimeIterationTerminationCondition(IterationTerminationCondition):
         self.max_seconds = max_seconds
 
     def initialize(self):
-        self._t0 = time.time()
+        # monotonic: an NTP step must not shorten (or extend) the budget
+        self._t0 = time.monotonic()
 
     def terminate(self, last_score):
-        return time.time() - self._t0 >= self.max_seconds
+        return time.monotonic() - self._t0 >= self.max_seconds
 
     def __str__(self):
         return f"MaxTimeIterationTerminationCondition({self.max_seconds}s)"

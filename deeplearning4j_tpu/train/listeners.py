@@ -65,12 +65,13 @@ class PerformanceListener(TrainingListener):
 
     def iteration_done(self, model, iteration, score, batch_size=0):
         now = time.perf_counter()
-        self._samples += batch_size
+        # anchor BEFORE accumulating: the anchoring call's batch used to be
+        # discarded (_samples zeroed after += batch_size), understating
+        # samples/sec for the first window
         if self._last_time is None:
             self._last_time = now
             self._last_iter = iteration
-            self._samples = 0
-            return
+        self._samples += batch_size
         if iteration - self._last_iter >= self.frequency:
             dt = now - self._last_time
             iters = iteration - self._last_iter
@@ -201,3 +202,19 @@ class ComposedListener(TrainingListener):
     def on_gradient_calculation(self, model, iteration):
         for l in self.listeners:
             l.on_gradient_calculation(model, iteration)
+
+    def close(self):
+        close_listeners(self.listeners)
+
+
+def close_listeners(listeners) -> None:
+    """Call ``close()`` on every listener that defines one (fit teardown:
+    stops in-flight ProfilerListener traces, flushes wrapped sinks). Errors
+    are logged, not raised — teardown must not mask the fit's own outcome."""
+    for l in listeners or ():
+        close = getattr(l, "close", None)
+        if callable(close):
+            try:
+                close()
+            except Exception:
+                logger.exception("listener %r close() failed", type(l).__name__)

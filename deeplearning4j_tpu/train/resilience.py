@@ -53,6 +53,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from deeplearning4j_tpu import obs
 from deeplearning4j_tpu.utils import bucketing
 
 __all__ = [
@@ -118,13 +119,14 @@ def validate_checkpoint(path, crc: Optional[int] = None,
 def capture_train_state(model) -> dict:
     """The JSON-able training state a model zip alone does not carry: RNG
     key (per-batch dropout/noise stream position), batch-in-epoch iterator
-    position, divergence-guard LR scale, and the bucketing/guard telemetry
-    snapshot (informational — restored runs keep their own counters)."""
+    position, divergence-guard LR scale, and the full observability snapshot
+    — metrics, span aggregates, event counts, bucketing counters
+    (informational — restored runs keep their own counters)."""
     state: Dict[str, Any] = {
         "version": 1,
         "batch_in_epoch": int(getattr(model, "batch_in_epoch", 0)),
         "lr_scale": float(getattr(model, "_lr_scale", 1.0)),
-        "telemetry": bucketing.telemetry().snapshot(),
+        "telemetry": obs.snapshot(),
     }
     rng = getattr(model, "_rng", None)
     if rng is not None:
@@ -143,18 +145,28 @@ def save_checkpoint(model, path, normalizer: Optional[dict] = None) -> dict:
     ``{"path", "crc", "size"}`` for the checkpoint index."""
     from deeplearning4j_tpu.utils import serialization as S
 
-    opt_state = None
-    residuals = None
-    runner = getattr(model, "_dp_runner", None)
-    if runner is not None:
-        if getattr(runner, "_active", False):
-            opt_state = runner.snapshot_opt_state()
-        residuals = runner.export_residuals() or None
-    S.save_network(model, path, normalizer=normalizer,
-                   train_state=capture_train_state(model),
-                   residuals=residuals, opt_state=opt_state)
-    return {"path": path, "crc": crc32_file(path),
-            "size": os.path.getsize(path)}
+    t0 = time.perf_counter()
+    with obs.span("checkpoint.save"):
+        opt_state = None
+        residuals = None
+        runner = getattr(model, "_dp_runner", None)
+        if runner is not None:
+            if getattr(runner, "_active", False):
+                opt_state = runner.snapshot_opt_state()
+            residuals = runner.export_residuals() or None
+        S.save_network(model, path, normalizer=normalizer,
+                       train_state=capture_train_state(model),
+                       residuals=residuals, opt_state=opt_state)
+        info = {"path": path, "crc": crc32_file(path),
+                "size": os.path.getsize(path)}
+    dur = time.perf_counter() - t0
+    obs.counter("dl4j_checkpoint_saves_total",
+                "Checkpoints written via save_checkpoint").inc()
+    obs.histogram("dl4j_checkpoint_save_seconds",
+                  "Wall time of durable checkpoint writes").observe(dur)
+    obs.event("checkpoint_saved", path=str(path), crc=info["crc"],
+              size=info["size"], duration_s=round(dur, 6))
+    return info
 
 
 def load_state_into(model, path):
@@ -163,9 +175,17 @@ def load_state_into(model, path):
     raise (config/checkpoint mismatch) rather than silently truncating."""
     from deeplearning4j_tpu.utils import serialization as S
 
-    if model.params is None:
-        model.init()
-    S.apply_snapshot(model, S.read_snapshot(path))
+    t0 = time.perf_counter()
+    with obs.span("checkpoint.restore"):
+        if model.params is None:
+            model.init()
+        S.apply_snapshot(model, S.read_snapshot(path))
+    dur = time.perf_counter() - t0
+    obs.counter("dl4j_checkpoint_restores_total",
+                "Checkpoints loaded via load_state_into/resume").inc()
+    obs.histogram("dl4j_checkpoint_restore_seconds",
+                  "Wall time of checkpoint restores").observe(dur)
+    obs.event("checkpoint_restored", path=str(path), duration_s=round(dur, 6))
     return model
 
 
@@ -178,6 +198,8 @@ def resume(model, directory):
 
     cp = CheckpointListener.last_valid_checkpoint(directory)
     if cp is None:
+        obs.event("checkpoint_corrupt_fallback", directory=str(directory),
+                  fallback="none")
         warnings.warn(
             f"resume_from={str(directory)!r}: no valid checkpoint found; "
             "training from the model's current state")
@@ -290,6 +312,8 @@ class DivergenceGuard:
     def _trip(self, model, value: float) -> None:
         self.trips += 1
         bucketing.telemetry().record_guard(self.policy)
+        obs.event("divergence", policy=self.policy, score=repr(value),
+                  trips=self.trips)
         if not self._warned:
             self._warned = True
             warnings.warn(
@@ -316,6 +340,8 @@ class DivergenceGuard:
         if runner is not None and getattr(runner, "_active", False):
             runner.reload()
         bucketing.telemetry().record_guard("rollback_restore")
+        obs.event("rollback_restore", retries=self.retries,
+                  lr_scale=float(model._lr_scale))
 
 
 _INVALID_SCORE_WARNED = False
@@ -330,6 +356,7 @@ def note_score(score: float) -> None:
     if math.isfinite(score):
         return
     bucketing.telemetry().record_guard("invalid_score")
+    obs.event("invalid_score", score=repr(score))
     global _INVALID_SCORE_WARNED
     if not _INVALID_SCORE_WARNED:
         _INVALID_SCORE_WARNED = True
@@ -462,6 +489,8 @@ class ChaosInjector:
             if (f.kind == "preempt" and not f.fired
                     and f.at_iter is not None and iteration >= f.at_iter):
                 f.fired = True
+                obs.event("chaos", fault="preempt", iteration=iteration,
+                          arg=f.arg)
                 if f.arg == "kill":
                     os.kill(os.getpid(), signal.SIGKILL)
                 raise ChaosPreemption(
@@ -474,6 +503,7 @@ class ChaosInjector:
             if f.at_iter is None or (iteration == f.at_iter and not f.fired):
                 if f.at_iter is not None:
                     f.fired = True
+                    obs.event("chaos", fault="slow_iter", iteration=iteration)
                 time.sleep(float(f.arg) if f.arg else 0.05)
 
     def maybe_nan_batch(self, iteration: int, x):
@@ -482,6 +512,7 @@ class ChaosInjector:
                 continue
             if f.at_iter is None or iteration == f.at_iter:
                 f.fired = True
+                obs.event("chaos", fault="nan_grad", iteration=iteration)
                 return _nan_like(x)
         return x
 
@@ -492,6 +523,8 @@ class ChaosInjector:
                 continue
             if f.at_ckpt is None or ckpt_number == f.at_ckpt:
                 f.fired = True
+                obs.event("chaos", fault="corrupt_ckpt", path=str(path),
+                          mode=f.arg or "bitflip")
                 corrupt_file(path, mode=f.arg or "bitflip")
 
 

@@ -355,6 +355,14 @@ class UIServer:
                         {"sessions": st.list_session_ids()} for st in outer.storages
                     ]).encode()
                     ctype = "application/json"
+                elif route == "/metrics":
+                    # Prometheus text exposition of the process-wide obs
+                    # registry (bucketing, comm bytes, checkpoint durations,
+                    # guard events, span summaries)
+                    from deeplearning4j_tpu import obs
+
+                    body = obs.prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
                 else:
                     self.send_response(404)
                     self.end_headers()

@@ -131,6 +131,21 @@ class StatsListener(TrainingListener):
             "updates": update_stats,
             "update_ratios": ratios,
         })
+        # mirror the headline scalars into the obs registry so /metrics
+        # serves them without a StatsStorage reader
+        from deeplearning4j_tpu import obs
+
+        obs.gauge("dl4j_training_score",
+                  "Last reported training score",
+                  ("session",)).set(float(score), session=self.session_id)
+        obs.counter("dl4j_training_iterations_total",
+                    "Iterations observed by StatsListener",
+                    ("session",)).inc(session=self.session_id)
+        if dt and self._samples:
+            obs.gauge("dl4j_training_samples_per_second",
+                      "Recent training throughput",
+                      ("session",)).set(self._samples / dt,
+                                        session=self.session_id)
         self._last_params = cur
         self._last_time = now
         self._samples = 0

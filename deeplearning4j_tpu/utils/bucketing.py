@@ -145,33 +145,72 @@ class BucketTelemetry:
     ``record_trace`` is called from INSIDE jitted python bodies — the body
     runs once per distinct input signature, so ``traces[site]`` counts actual
     traces/compiles, not calls. ``record_hit`` counts one padded dispatch.
-    """
 
-    def __init__(self):
+    Since PR 5 this class is an **adapter shim** over the obs metrics
+    registry (``deeplearning4j_tpu/obs/``): the counters live in registry
+    families (``dl4j_bucketing_*``, ``dl4j_comm_bytes``,
+    ``dl4j_guard_events_total``) so they are scrapeable at /metrics, while
+    every pre-existing accessor (``traces``, ``bucket_hits``, ``comm``,
+    ``guard_events``, ``snapshot()``, ...) keeps its exact shape. The
+    process singleton (``telemetry()``) shares the process registry and
+    emits trace / bucket-promotion events; ad-hoc instances get a private
+    registry so tests can't cross-talk."""
+
+    def __init__(self, registry=None, emit_events: bool = False):
+        from deeplearning4j_tpu.obs import metrics as _obs_metrics
+
         self._lock = threading.Lock()
-        self.reset()
+        self._emit_events = emit_events
+        reg = registry if registry is not None else _obs_metrics.MetricsRegistry()
+        self._traces = reg.counter(
+            "dl4j_bucketing_traces_total",
+            "XLA traces/compiles by jitted site (recorded inside traced "
+            "bodies, so this counts compiles, not calls)", ("site",))
+        self._hits = reg.counter(
+            "dl4j_bucketing_hits_total",
+            "padded dispatches by site and bucket rung", ("site", "bucket"))
+        self._padded = reg.counter(
+            "dl4j_bucketing_padded_examples_total",
+            "padding waste: rows added to reach bucket rungs")
+        self._real = reg.counter(
+            "dl4j_bucketing_real_examples_total",
+            "real rows dispatched through bucketed paths")
+        self._comm = reg.gauge(
+            "dl4j_comm_bytes",
+            "per-step collective bytes by exchange site (dense = hypothetical "
+            "dense all-reduce, wire = configured exchange, param = sharded-"
+            "update all-gather); describes a configuration, latest wins",
+            ("site", "kind"))
+        self._guard = reg.counter(
+            "dl4j_guard_events_total",
+            "divergence-guard events (invalid_score, warn/skip_batch/"
+            "rollback trips, rollback_restore)", ("event",))
+        self.trace_shapes: Dict[str, set] = {}
 
     def reset(self):
-        with getattr(self, "_lock", threading.Lock()):
-            self.traces: Dict[str, int] = {}
-            self.trace_shapes: Dict[str, set] = {}
-            self.bucket_hits: Dict[Tuple[str, int], int] = {}
-            self.padded_examples = 0
-            self.real_examples = 0
-            self.comm: Dict[str, Dict[str, int]] = {}
-            self.guard_events: Dict[str, int] = {}
+        with self._lock:
+            for fam in (self._traces, self._hits, self._padded, self._real,
+                        self._comm, self._guard):
+                fam.clear()
+            self.trace_shapes = {}
 
     def record_trace(self, site: str, shape: Sequence[int]):
         with self._lock:
-            self.traces[site] = self.traces.get(site, 0) + 1
             self.trace_shapes.setdefault(site, set()).add(tuple(shape))
+        count = self._traces.inc(site=site)
+        if self._emit_events:
+            from deeplearning4j_tpu import obs
+
+            obs.event("trace", site=site, shape=list(shape), compiles=int(count))
 
     def record_hit(self, site: str, n: int, bucket: int):
-        with self._lock:
-            key = (site, bucket)
-            self.bucket_hits[key] = self.bucket_hits.get(key, 0) + 1
-            self.real_examples += n
-            self.padded_examples += max(bucket - n, 0)
+        first = self._hits.inc(site=site, bucket=bucket) == 1
+        self._real.inc(n)
+        self._padded.inc(max(bucket - n, 0))
+        if first and self._emit_events:
+            from deeplearning4j_tpu import obs
+
+            obs.event("bucket_promotion", site=site, bucket=int(bucket))
 
     def record_comm(self, site: str, dense_bytes: int, wire_bytes: int,
                     param_bytes: int = 0):
@@ -182,47 +221,76 @@ class BucketTelemetry:
         ``param_bytes`` = sharded-update's extra updated-param all-gather.
         Latest values win — the numbers describe a configuration, not a
         running total."""
-        with self._lock:
-            self.comm[site] = {
-                "dense_bytes": int(dense_bytes),
-                "wire_bytes": int(wire_bytes),
-                "param_bytes": int(param_bytes),
-            }
+        self._comm.set(int(dense_bytes), site=site, kind="dense_bytes")
+        self._comm.set(int(wire_bytes), site=site, kind="wire_bytes")
+        self._comm.set(int(param_bytes), site=site, kind="param_bytes")
 
     def record_guard(self, event: str):
         """Count one divergence-guard event (``invalid_score``, a policy trip
         ``warn``/``skip_batch``/``rollback``, or ``rollback_restore``) — the
         InvalidScoreIterationTerminationCondition-style counters surfaced in
         snapshots (train/resilience.py)."""
-        with self._lock:
-            self.guard_events[event] = self.guard_events.get(event, 0) + 1
+        self._guard.inc(event=event)
+
+    # -- pre-obs accessors (shim views over the registry families) ---------
+
+    @property
+    def traces(self) -> Dict[str, int]:
+        return {k[0]: int(v) for k, v in self._traces.as_dict().items()}
+
+    @property
+    def bucket_hits(self) -> Dict[Tuple[str, int], int]:
+        return {(k[0], int(k[1])): int(v)
+                for k, v in self._hits.as_dict().items()}
+
+    @property
+    def padded_examples(self) -> int:
+        return int(self._padded.value())
+
+    @property
+    def real_examples(self) -> int:
+        return int(self._real.value())
+
+    @property
+    def comm(self) -> Dict[str, Dict[str, int]]:
+        out: Dict[str, Dict[str, int]] = {}
+        for (site, kind), v in self._comm.as_dict().items():
+            out.setdefault(site, {})[kind] = int(v)
+        return out
+
+    @property
+    def guard_events(self) -> Dict[str, int]:
+        return {k[0]: int(v) for k, v in self._guard.as_dict().items()}
 
     def compiles(self, site: Optional[str] = None) -> int:
-        with self._lock:
-            if site is not None:
-                return self.traces.get(site, 0)
-            return sum(self.traces.values())
+        if site is not None:
+            return int(self._traces.value(site=site))
+        return sum(self.traces.values())
 
     def buckets_used(self, site: Optional[str] = None) -> Tuple[int, ...]:
-        with self._lock:
-            return tuple(sorted({b for (s, b) in self.bucket_hits
-                                 if site is None or s == site}))
+        return tuple(sorted({int(b) for (s, b) in self._hits.as_dict()
+                             if site is None or s == site}))
 
     def snapshot(self) -> dict:
         """JSON-friendly view for bench extras."""
-        with self._lock:
-            return {
-                "traces": dict(self.traces),
-                "bucket_hits": {f"{s}:{b}": c
-                                for (s, b), c in sorted(self.bucket_hits.items())},
-                "padded_examples": self.padded_examples,
-                "real_examples": self.real_examples,
-                "comm": {s: dict(v) for s, v in self.comm.items()},
-                "guard": dict(self.guard_events),
-            }
+        return {
+            "traces": self.traces,
+            "bucket_hits": {f"{s}:{b}": c
+                            for (s, b), c in sorted(self.bucket_hits.items())},
+            "padded_examples": self.padded_examples,
+            "real_examples": self.real_examples,
+            "comm": self.comm,
+            "guard": self.guard_events,
+        }
 
 
-_TELEMETRY = BucketTelemetry()
+def _process_telemetry() -> BucketTelemetry:
+    from deeplearning4j_tpu.obs import metrics as _obs_metrics
+
+    return BucketTelemetry(registry=_obs_metrics.registry(), emit_events=True)
+
+
+_TELEMETRY = _process_telemetry()
 
 
 def telemetry() -> BucketTelemetry:

@@ -1,0 +1,36 @@
+"""graftlint fixture: monotonic-clock true positives."""
+
+import time
+
+
+def elapsed_direct():
+    t0 = time.time()
+    work()
+    return time.time() - t0          # BAD: duration from the wall clock
+
+
+def deadline_compare(budget):
+    deadline = time.time() + budget  # BAD: deadline arithmetic
+    while time.time() < deadline:    # BAD: ordering compare on wall clock
+        work()
+
+
+def timestamp_only(record):
+    record["ts"] = time.time()       # OK: value-only use, never flagged
+    return record
+
+
+def suppressed():
+    t0 = time.time()
+    work()
+    return time.time() - t0  # graftlint: disable=monotonic-clock
+
+
+def monotonic_ok():
+    t0 = time.monotonic()
+    work()
+    return time.monotonic() - t0     # OK: the right clock
+
+
+def work():
+    pass

@@ -82,6 +82,18 @@ class TestRuleTruePositives:
         # mutation under the lock is clean
         assert not _hits(fs, "lock-discipline", "locks_bad.py", "put_locked")
 
+    def test_monotonic_clock(self, fixture_findings):
+        fs = fixture_findings
+        assert _hits(fs, "monotonic-clock", "clock_bad.py", "elapsed_direct")
+        # both the deadline arithmetic and the ordering compare flag
+        assert len(_hits(fs, "monotonic-clock", "clock_bad.py",
+                         "deadline_compare")) == 2
+        # value-only timestamps and the monotonic clock stay allowed
+        assert not _hits(fs, "monotonic-clock", "clock_bad.py",
+                         "timestamp_only")
+        assert not _hits(fs, "monotonic-clock", "clock_bad.py",
+                         "monotonic_ok")
+
     def test_inline_suppressions(self, fixture_findings):
         fs = fixture_findings
         for rule, filename, func in (
@@ -90,6 +102,7 @@ class TestRuleTruePositives:
             ("jit-purity", "purity_bad.py", "quiet_step"),
             ("numpy-on-tracer", "tracer_np_bad.py", "suppressed"),
             ("lock-discipline", "locks_bad.py", "put_suppressed"),
+            ("monotonic-clock", "clock_bad.py", "suppressed"),
         ):
             assert not _hits(fs, rule, filename, func), (rule, func)
 

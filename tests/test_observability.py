@@ -1,0 +1,410 @@
+"""Unified observability layer (ISSUE 5): registry semantics incl. thread
+safety, span nesting, JSONL event schema + rotation, Prometheus exposition
+via /metrics, obs.snapshot() round-trip through the resilience checkpoint
+telemetry field, and the listener satellites (PerformanceListener sample
+accounting, listener close() on fit exit)."""
+
+import json
+import os
+import re
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import obs
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+from deeplearning4j_tpu.nn.model import (
+    MultiLayerConfiguration,
+    MultiLayerNetwork,
+)
+from deeplearning4j_tpu.obs.events import EventLog
+from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+from deeplearning4j_tpu.obs.spans import SpanTracer
+from deeplearning4j_tpu.train import listeners as listeners_mod
+from deeplearning4j_tpu.train.listeners import (
+    ComposedListener,
+    PerformanceListener,
+    TrainingListener,
+)
+from deeplearning4j_tpu.utils import bucketing
+
+
+@pytest.fixture(autouse=True)
+def _obs_isolation(monkeypatch):
+    monkeypatch.delenv("DL4J_TPU_OBS", raising=False)
+    monkeypatch.delenv("DL4J_TPU_EVENT_LOG", raising=False)
+    obs.reset()
+    bucketing.telemetry().reset()
+    yield
+    obs.configure_event_log(None)
+    obs.reset()
+    bucketing.telemetry().reset()
+
+
+def _mlp_conf():
+    return MultiLayerConfiguration(
+        layers=(Dense(n_out=8, activation="tanh"),
+                OutputLayer(n_out=2, activation="softmax")),
+        input_type=InputType.feed_forward(4),
+        updater={"type": "sgd", "lr": 0.05},
+        seed=3,
+    )
+
+
+def _toy_data(n=32):
+    rs = np.random.RandomState(0)
+    x = rs.rand(n, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, n)]
+    return x, y
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_counter_get_or_create_and_first_touch(self):
+        reg = MetricsRegistry()
+        c = reg.counter("t_total", "help", ("site",))
+        assert reg.counter("t_total", "other", ("site",)) is c
+        assert c.inc(site="a") == 1      # first touch is detectable
+        assert c.inc(2, site="a") == 3
+        assert c.value(site="a") == 3
+        assert c.value(site="b") == 0
+
+    def test_kind_and_label_mismatch_raise(self):
+        reg = MetricsRegistry()
+        reg.counter("m", "", ("a",))
+        with pytest.raises(ValueError):
+            reg.gauge("m", "", ("a",))
+        with pytest.raises(ValueError):
+            reg.counter("m", "", ("b",))
+        with pytest.raises(ValueError):
+            reg.counter("m", "", ("a",)).inc(wrong="x")
+
+    def test_histogram_summary(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", "", ("op",))
+        for v in range(100):
+            h.observe(float(v), op="save")
+        s = h.summary(op="save")
+        assert s["count"] == 100
+        assert s["sum"] == pytest.approx(4950.0)
+        assert s["min"] == 0.0 and s["max"] == 99.0
+        assert s["p50"] == pytest.approx(50.0, abs=2)
+        assert h.summary(op="missing") is None
+
+    def test_reset_keeps_registrations(self):
+        reg = MetricsRegistry()
+        c = reg.counter("kept", "", ("k",))
+        c.inc(k="x")
+        reg.reset()
+        assert c.value(k="x") == 0
+        # the same family object is still wired into the registry
+        assert reg.counter("kept", "", ("k",)) is c
+
+    def test_snapshot_shape(self):
+        reg = MetricsRegistry()
+        reg.counter("c", "", ("site",)).inc(site="s1")
+        reg.gauge("g").set(2.5)
+        reg.histogram("h", "", ("op",)).observe(1.0, op="x")
+        snap = reg.snapshot()
+        assert snap["c"] == {"site=s1": 1}
+        assert snap["g"] == {"": 2.5}
+        assert snap["h"]["op=x"]["count"] == 1
+        json.dumps(snap)  # JSON-friendly end to end
+
+    def test_thread_safety_exact_totals(self):
+        reg = MetricsRegistry()
+        c = reg.counter("conc_total", "", ("site",))
+        h = reg.histogram("conc_lat")
+        n_threads, per_thread = 8, 500
+
+        def work():
+            for _ in range(per_thread):
+                c.inc(site="s")
+                h.observe(1.0)
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value(site="s") == n_threads * per_thread
+        assert h.summary()["count"] == n_threads * per_thread
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+
+class TestSpans:
+    def test_nesting_parent_and_depth(self):
+        tr = SpanTracer(MetricsRegistry())
+        with tr.span("outer"):
+            with tr.span("inner"):
+                pass
+        inner, outer = tr.recent()[-2:]
+        assert inner["span"] == "inner"
+        assert inner["parent"] == "outer" and inner["depth"] == 1
+        assert outer["span"] == "outer"
+        assert outer["parent"] is None and outer["depth"] == 0
+        assert inner["wall_s"] >= 0 and inner["cpu_s"] >= 0
+
+    def test_error_flag_and_summary(self):
+        tr = SpanTracer(MetricsRegistry())
+        with pytest.raises(RuntimeError):
+            with tr.span("boom"):
+                raise RuntimeError("x")
+        assert tr.recent()[-1]["error"] is True
+        s = tr.summary()["boom"]
+        assert s["count"] == 1 and s["wall_sum_s"] >= 0
+
+    def test_disabled_records_nothing(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_OBS", "0")
+        tr = SpanTracer(MetricsRegistry())
+        with tr.span("off"):
+            pass
+        assert tr.recent() == []
+        assert tr.summary() == {}
+
+    def test_fit_records_model_spans(self):
+        x, y = _toy_data()
+        model = MultiLayerNetwork(_mlp_conf()).init()
+        model.fit((x, y), epochs=2)
+        names = {r["span"] for r in obs.recent_spans()}
+        assert "mln.fit_batch" in names
+        model.output(x)
+        names = {r["span"] for r in obs.recent_spans()}
+        assert "mln.output" in names
+
+
+# ---------------------------------------------------------------------------
+# event log
+# ---------------------------------------------------------------------------
+
+
+class TestEventLog:
+    def test_jsonl_schema(self, tmp_path):
+        log = EventLog(MetricsRegistry())
+        p = tmp_path / "events.jsonl"
+        log.configure(str(p))
+        log.emit("checkpoint_saved", path="/x.zip", crc=7, size=100)
+        log.emit("divergence", policy="warn", trips=1)
+        lines = [json.loads(l) for l in p.read_text().splitlines()]
+        assert [l["kind"] for l in lines] == ["checkpoint_saved", "divergence"]
+        for l in lines:
+            assert isinstance(l["ts"], float)
+        assert lines[0]["crc"] == 7
+        assert log.counts() == {"checkpoint_saved": 1, "divergence": 1}
+
+    def test_rotation_bounds_disk(self, tmp_path):
+        log = EventLog(MetricsRegistry())
+        p = tmp_path / "events.jsonl"
+        log.configure(str(p), max_bytes=2048)
+        for i in range(200):
+            log.emit("tick", i=i, pad="x" * 64)
+        assert p.exists() and os.path.exists(str(p) + ".1")
+        assert os.path.getsize(p) <= 2048
+        # both generations still parse line-by-line
+        for f in (str(p), str(p) + ".1"):
+            for line in open(f):
+                json.loads(line)
+
+    def test_never_crashes_on_unserializable(self, tmp_path):
+        log = EventLog(MetricsRegistry())
+        p = tmp_path / "events.jsonl"
+        log.configure(str(p))
+        log.emit("weird", obj=object())       # default=str handles it
+        log.emit("ok")
+        recs = [json.loads(l) for l in p.read_text().splitlines()]
+        assert [r["kind"] for r in recs] == ["weird", "ok"]
+
+    def test_env_knob_adopted_lazily(self, tmp_path, monkeypatch):
+        p = tmp_path / "env_events.jsonl"
+        monkeypatch.setenv("DL4J_TPU_EVENT_LOG", str(p))
+        log = EventLog(MetricsRegistry())
+        log.emit("via_env")
+        assert json.loads(p.read_text())["kind"] == "via_env"
+
+    def test_obs_event_respects_kill_switch(self, tmp_path, monkeypatch):
+        p = tmp_path / "events.jsonl"
+        obs.configure_event_log(str(p))
+        monkeypatch.setenv("DL4J_TPU_OBS", "0")
+        obs.event("muted")
+        assert not p.exists() or p.read_text() == ""
+
+
+# ---------------------------------------------------------------------------
+# exposition
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.einf+-]+$')
+
+
+class TestExposition:
+    def test_prometheus_text_parses(self):
+        obs.counter("dl4j_demo_total", "demo", ("site",)).inc(site="a b")
+        obs.histogram("dl4j_demo_seconds", "demo", ("span",)).observe(
+            0.5, span="s")
+        text = obs.prometheus_text()
+        assert '# TYPE dl4j_demo_total counter' in text
+        assert '# TYPE dl4j_demo_seconds summary' in text
+        assert 'dl4j_demo_total{site="a b"} 1' in text
+        assert 'quantile="0.99"' in text
+        for line in text.splitlines():
+            if line.startswith("#") or not line:
+                continue
+            assert _SAMPLE_RE.match(line), line
+
+    def test_metrics_route_serves_registry(self):
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        bucketing.telemetry().record_trace("mln.step", (32, 4))
+        bucketing.telemetry().record_hit("mln.fit", 30, 32)
+        obs.event("route_check")
+        srv = UIServer().serve(port=0)
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/metrics") as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith("text/plain")
+                body = resp.read().decode()
+        finally:
+            srv.stop()
+        assert 'dl4j_bucketing_traces_total{site="mln.step"} 1' in body
+        assert 'dl4j_bucketing_hits_total' in body
+        assert 'dl4j_events_total{kind="route_check"} 1' in body
+
+
+# ---------------------------------------------------------------------------
+# snapshot round-trip through the resilience checkpoint telemetry field
+# ---------------------------------------------------------------------------
+
+
+class TestSnapshotRoundTrip:
+    def test_snapshot_embeds_all_views(self):
+        bucketing.telemetry().record_hit("mln.fit", 30, 32)
+        with obs.span("unit"):
+            pass
+        obs.event("snap_check")
+        snap = obs.snapshot()
+        assert set(snap) == {"metrics", "spans", "events", "bucketing"}
+        assert snap["bucketing"]["real_examples"] == 30
+        assert snap["events"]["snap_check"] == 1
+        assert snap["spans"]["unit"]["count"] == 1
+        json.dumps(snap)
+
+    def test_checkpoint_telemetry_field_round_trips(self, tmp_path):
+        from deeplearning4j_tpu.train import resilience
+        from deeplearning4j_tpu.utils import serialization as S
+
+        x, y = _toy_data()
+        model = MultiLayerNetwork(_mlp_conf()).init()
+        model.fit((x, y), epochs=1)
+        path = str(tmp_path / "ckpt.zip")
+        info = resilience.save_checkpoint(model, path)
+        assert resilience.validate_checkpoint(
+            path, crc=info["crc"], size=info["size"])
+
+        tel = S.read_snapshot(path)["train_state"]["telemetry"]
+        # the telemetry field IS an obs.snapshot(), intact through the zip
+        assert set(tel) == {"metrics", "spans", "events", "bucketing"}
+        assert "mln.fit_batch" in tel["spans"]
+        assert tel["bucketing"]["traces"].get("mln.step") == 1
+
+        resilience.load_state_into(MultiLayerNetwork(_mlp_conf()), path)
+        reg_snap = obs.snapshot()["metrics"]
+        assert reg_snap["dl4j_checkpoint_saves_total"][""] == 1
+        assert reg_snap["dl4j_checkpoint_restores_total"][""] == 1
+        assert reg_snap["dl4j_checkpoint_save_seconds"][""]["count"] == 1
+        assert reg_snap["dl4j_checkpoint_restore_seconds"][""]["count"] == 1
+        assert obs.snapshot()["events"]["checkpoint_saved"] == 1
+        assert obs.snapshot()["events"]["checkpoint_restored"] == 1
+
+
+# ---------------------------------------------------------------------------
+# listener satellites
+# ---------------------------------------------------------------------------
+
+
+class _Closeable(TrainingListener):
+    def __init__(self):
+        self.closed = 0
+
+    def close(self):
+        self.closed += 1
+
+
+class TestPerformanceListener:
+    def test_first_window_counts_anchor_batch(self, monkeypatch):
+        clock = [0.0]
+        monkeypatch.setattr(listeners_mod.time, "perf_counter",
+                            lambda: clock[0])
+        pl = PerformanceListener(frequency=2, out=lambda s: None)
+        for it in range(3):           # iterations 0, 1, 2 — one per second
+            pl.iteration_done(None, it, 0.1, batch_size=32)
+            clock[0] += 1.0
+        assert len(pl.history) == 1
+        rec = pl.history[0]
+        # window covers 2 iterations over 2s; all THREE calls' samples count
+        # (the anchoring call's batch used to be discarded -> 32/s)
+        assert rec["batches_per_sec"] == pytest.approx(1.0)
+        assert rec["samples_per_sec"] == pytest.approx(48.0)
+
+    def test_steady_state_windows_unchanged(self, monkeypatch):
+        clock = [0.0]
+        monkeypatch.setattr(listeners_mod.time, "perf_counter",
+                            lambda: clock[0])
+        pl = PerformanceListener(frequency=2, out=lambda s: None)
+        for it in range(7):
+            pl.iteration_done(None, it, 0.1, batch_size=10)
+            clock[0] += 1.0
+        # windows at iterations 2, 4, 6; later windows hold 2 batches each
+        assert len(pl.history) == 3
+        for rec in pl.history[1:]:
+            assert rec["samples_per_sec"] == pytest.approx(10.0)
+
+
+class TestListenerClose:
+    def test_fit_closes_listeners(self):
+        x, y = _toy_data()
+        model = MultiLayerNetwork(_mlp_conf()).init()
+        closeable = _Closeable()
+        model.set_listeners(closeable)
+        model.fit((x, y), epochs=1)
+        assert closeable.closed == 1
+
+    def test_fit_closes_even_when_fit_raises(self):
+        x, y = _toy_data()
+        model = MultiLayerNetwork(_mlp_conf()).init()
+
+        class Bomb(TrainingListener):
+            def iteration_done(self, model, iteration, score, batch_size=0):
+                raise RuntimeError("listener bomb")
+
+        closeable = _Closeable()
+        model.set_listeners(Bomb(), closeable)
+        with pytest.raises(RuntimeError):
+            model.fit((x, y), epochs=1)
+        assert closeable.closed == 1
+
+    def test_composed_listener_fans_out_close(self):
+        a, b = _Closeable(), _Closeable()
+        ComposedListener([a, b]).close()
+        assert (a.closed, b.closed) == (1, 1)
+
+    def test_close_errors_logged_not_raised(self):
+        class BadClose(TrainingListener):
+            def close(self):
+                raise RuntimeError("teardown bomb")
+
+        ok = _Closeable()
+        listeners_mod.close_listeners([BadClose(), ok])
+        assert ok.closed == 1

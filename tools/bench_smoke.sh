@@ -8,7 +8,8 @@ cd "$(dirname "$0")/.."
 out=$(BENCH_SMOKE=1 JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python bench.py)
 echo "$out"
 
-# every registered metric present, none carrying an "error" field
+# every registered metric present, none carrying an "error" field, and every
+# one embedding its obs.snapshot() (docs/OBSERVABILITY.md)
 python - "$out" <<'EOF'
 import json
 import sys
@@ -23,5 +24,10 @@ import bench
 if len(extras) != len(bench._BENCHES):
     sys.exit(f"bench smoke: {len(extras)} metrics, "
              f"expected {len(bench._BENCHES)}")
-print(f"bench smoke OK: {len(extras)} metrics, no errors")
+no_obs = [m["metric"] for m in extras
+          if not isinstance(m.get("obs"), dict)
+          or not {"metrics", "spans", "events", "bucketing"} <= m["obs"].keys()]
+if no_obs:
+    sys.exit(f"bench smoke: metrics missing obs snapshot: {no_obs}")
+print(f"bench smoke OK: {len(extras)} metrics, no errors, obs embedded")
 EOF
