@@ -35,10 +35,13 @@ __all__ = [
     "RetraceError",
     "RetraceGuard",
     "RetraceWarning",
+    "aot_warmed_buckets",
     "check",
     "check_if_enabled",
     "enabled",
     "predicted_compiles",
+    "register_aot_warmed",
+    "reset_aot_warmed",
     "reset_warnings",
     "strict",
 ]
@@ -81,12 +84,44 @@ def reset_warnings() -> None:
         _warned.clear()
 
 
+# AOT cross-registration (nn/aot.py): buckets compiled ahead of time have a
+# legitimate trace each even before any traffic hits them, so the predicted
+# bound below unions warmed buckets with observed traffic. Conversely the AOT
+# warmup enumerates the SAME ladder the guard bounds against
+# (``aot.reachable_buckets``), so the two subsystems cross-check: AOT warming
+# a bucket the guard never sees traffic for is accounted, and traffic in a
+# bucket AOT failed to enumerate shows up as a lazy compile within the bound.
+_aot_warmed: dict = {}
+_aot_lock = threading.Lock()
+
+
+def register_aot_warmed(site: str, buckets) -> None:
+    """Record that ``site`` was AOT-compiled for ``buckets`` (leading-dim
+    rungs), extending the predicted compile bound accordingly."""
+    with _aot_lock:
+        _aot_warmed.setdefault(site, set()).update(int(b) for b in buckets)
+
+
+def aot_warmed_buckets(site: str) -> frozenset:
+    with _aot_lock:
+        return frozenset(_aot_warmed.get(site, ()))
+
+
+def reset_aot_warmed() -> None:
+    with _aot_lock:
+        _aot_warmed.clear()
+
+
 def predicted_compiles(site: str, hits_site: Optional[str] = None) -> Optional[int]:
     """Ladder-predicted compile bound for ``site``: the number of distinct
-    buckets its traffic hit. Trace and hit counters may live under different
-    site names (e.g. traces at ``mln.step``, hits at ``mln.fit``) —
-    ``hits_site`` names the hit counter when they differ."""
-    used = bucketing.telemetry().buckets_used(hits_site or site)
+    buckets its traffic hit, unioned with buckets AOT-warmed for the site
+    (``register_aot_warmed``). Trace and hit counters may live under
+    different site names (e.g. traces at ``mln.step``, hits at ``mln.fit``)
+    — ``hits_site`` names the hit counter when they differ."""
+    used = set(bucketing.telemetry().buckets_used(hits_site or site))
+    used |= aot_warmed_buckets(site)
+    if hits_site:
+        used |= aot_warmed_buckets(hits_site)
     return len(used) if used else None
 
 

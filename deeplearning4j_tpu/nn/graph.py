@@ -34,6 +34,7 @@ import numpy as np
 
 from deeplearning4j_tpu import obs
 from deeplearning4j_tpu.analysis import retrace_guard
+from deeplearning4j_tpu.nn import aot
 from deeplearning4j_tpu.nn.config import LayerConfig, layer_from_dict, _encode_value
 from deeplearning4j_tpu.nn.input_type import InputType
 from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrent
@@ -751,10 +752,13 @@ class ComputationGraph:
 
     def _clear_compiled(self):
         """Drop compiled step closures (updaters or divergence-guard config
-        changed — both are baked into the trace)."""
+        changed — both are baked into the trace). AOT-warmed step
+        executables are stale for the same reason; the output path is
+        untouched (inference doesn't trace updaters or guards)."""
         self._step_fn = None
         self._tbptt_step_fn = None
         self._chain_step_fn = None
+        aot.clear_sites(self, ("cg.step", "cg.step.tbptt"))
 
     def set_divergence_guard(self, guard) -> "ComputationGraph":
         """Install a train/resilience.DivergenceGuard (None to remove).
@@ -960,10 +964,12 @@ class ComputationGraph:
     def _get_step_fn(self, with_carries: bool):
         if with_carries:
             if self._tbptt_step_fn is None:
-                self._tbptt_step_fn = self._make_step(True)
+                self._tbptt_step_fn = aot.wrap(
+                    self._make_step(True), "cg.step.tbptt", model=self)
             return self._tbptt_step_fn
         if self._step_fn is None:
-            self._step_fn = self._make_step(False)
+            self._step_fn = aot.wrap(
+                self._make_step(False), "cg.step", model=self)
         return self._step_fn
 
     # -- chained steps (K per dispatch; mirrors MultiLayerNetwork) ---------
@@ -1087,6 +1093,18 @@ class ComputationGraph:
                 resume_skip = int(getattr(self, "batch_in_epoch", 0))
                 epochs = max(epochs - self.epoch, 0)
         guard = getattr(self, "divergence_guard", None)
+        if aot.enabled():
+            # time-to-first-step becomes a warm-path number: compile (or
+            # reuse a bundle-restored executable for) the first batch's step
+            # signature before the epoch loop dispatches. Mirrors the
+            # per-epoch tbptt/chain gating below for epoch 0.
+            _tbptt0 = (self.conf.backprop_type == "tbptt"
+                       and bool(self._time_distributed_inputs()))
+            _chain0 = (self._chain_k()
+                       if not (self.listeners or _tbptt0) and guard is None
+                       else 0)
+            if not _tbptt0 and _chain0 <= 1:
+                aot.warm_fit(self, data, batch_size)
         try:
             for _ in range(epochs):
                 skip_n, resume_skip = resume_skip, 0
@@ -1346,6 +1364,22 @@ class ComputationGraph:
         return k
 
     # -- inference ---------------------------------------------------------
+    def _get_output_fn(self):
+        """The jitted inference entry point, AOT-wrapped so warmup
+        (``nn/aot.py``) can pre-compile every ladder bucket and bundle
+        restore can install persisted executables."""
+        if self._output_fn is None:
+            def fwd(params, state, inputs, masks):
+                # python body runs once per trace → counts actual compiles
+                bucketing.telemetry().record_trace(
+                    "cg.output", np.shape(next(iter(inputs.values()))))
+                acts, _, _, _ = self._forward(params, state, inputs, train=False,
+                                              rngs=None, masks=masks)
+                return tuple(acts[o] for o in self.conf.outputs)
+
+            self._output_fn = aot.wrap(jax.jit(fwd), "cg.output", model=self)
+        return self._output_fn
+
     def output(self, *xs, fmasks=None):
         """Outputs of all output vertices (ComputationGraph.output:1754).
         Returns a single array when the graph has one output.
@@ -1359,16 +1393,7 @@ class ComputationGraph:
             xs = tuple(xs[0])
         feats = tuple(_cast_input(x, self.dtype) for x in xs)
         fm = self._norm_multi(fmasks, len(self.conf.inputs)) if fmasks is not None else None
-        if self._output_fn is None:
-            def fwd(params, state, inputs, masks):
-                # python body runs once per trace → counts actual compiles
-                bucketing.telemetry().record_trace(
-                    "cg.output", np.shape(next(iter(inputs.values()))))
-                acts, _, _, _ = self._forward(params, state, inputs, train=False,
-                                              rngs=None, masks=masks)
-                return tuple(acts[o] for o in self.conf.outputs)
-
-            self._output_fn = jax.jit(fwd)
+        self._get_output_fn()
         n = feats[0].shape[0] if feats else 0
         with obs.span("cg.output"):
             if (bucketing.bucketing_enabled() and n > 0

@@ -97,19 +97,20 @@ def _memory_report_cg(model, batch_size: int) -> MemoryReport:
                    for t in model.output_types)
     inputs = model._input_dict(feats)
 
-    def fwd(params, state, inputs):
-        acts, _, _, _ = model._forward(params, state, inputs, train=False,
-                                       rngs=None)
-        return tuple(acts[o] for o in model.conf.outputs)
-
-    inf = _analyze(jax.jit(fwd).lower(model.params, model.state,
-                                      inputs).compile())
-    step = model._make_step(False)
+    # the model's OWN jitted entry points via the AOT cache: the executables
+    # analyzed here are exactly the ones output()/fit_batch() will dispatch,
+    # so a report no longer costs a second compile per path (and vice versa
+    # — a report AFTER traffic reuses the live executables). ex_weight=None
+    # is passed explicitly: jit binds no defaults, so omitting it would key
+    # a different signature than fit_batch's call.
     rng = jax.random.PRNGKey(0)
-    tr = _analyze(step.lower(
+    inf = _analyze(model._get_output_fn().warm(
+        model.params, model.state, inputs, None))
+    tr = _analyze(model._get_step_fn(False).warm(
         model.params, model.opt_state, model.state,
         jnp.asarray(0, jnp.int32), rng, inputs, labels, None, None, {},
-    ).compile())
+        ex_weight=None,
+    ))
     return MemoryReport(
         model_class=type(model).__name__,
         batch_size=batch_size,
@@ -133,22 +134,18 @@ def memory_report(model, batch_size: int = 32) -> MemoryReport:
     x = _dummy_for(model.conf.input_type, batch_size, model.dtype)
     y = _dummy_for(model.output_type, batch_size, model.dtype)
 
-    # inference executable
-    def fwd(params, state, x):
-        a, _, _, _, _ = model._forward(params, state, x, train=False, rngs=None)
-        return a
-
-    inf = _analyze(jax.jit(fwd).lower(model.params, model.state, x).compile())
-
-    # training executable (the real step, including updater math)
-    step = model._make_step(False)
+    # the model's OWN jitted entry points via the AOT cache (see the
+    # ComputationGraph variant above for why): the inference and training
+    # executables analyzed here serve subsequent output()/fit() traffic of
+    # the same shape instead of being compiled twice
     rng = jax.random.PRNGKey(0)
-    tr = _analyze(
-        step.lower(
-            model.params, model.opt_state, model.state,
-            jnp.asarray(0, jnp.int32), rng, x, y, None, None, (),
-        ).compile()
-    )
+    inf = _analyze(model._get_output_fn().warm(
+        model.params, model.state, x, None))
+    tr = _analyze(model._get_step_fn(False).warm(
+        model.params, model.opt_state, model.state,
+        jnp.asarray(0, jnp.int32), rng, x, y, None, None, (),
+        ex_weight=None,
+    ))
     return MemoryReport(
         model_class=type(model).__name__,
         batch_size=batch_size,

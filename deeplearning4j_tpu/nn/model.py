@@ -32,6 +32,7 @@ import numpy as np
 
 from deeplearning4j_tpu import obs
 from deeplearning4j_tpu.analysis import retrace_guard
+from deeplearning4j_tpu.nn import aot
 from deeplearning4j_tpu.nn.config import LayerConfig, layer_from_dict, _encode_value
 from deeplearning4j_tpu.utils import bucketing
 from deeplearning4j_tpu.nn.input_type import InputType
@@ -368,11 +369,14 @@ class MultiLayerNetwork:
 
     def _clear_compiled(self):
         """Drop compiled step closures (updaters or divergence-guard config
-        changed — both are baked into the trace)."""
+        changed — both are baked into the trace). AOT-warmed step
+        executables are stale for the same reason; the output path is
+        untouched (inference doesn't trace updaters or guards)."""
         self._step_fn = None
         self._tbptt_step_fn = None
         self._chain_step_fn = None
         self._solver = None
+        aot.clear_sites(self, ("mln.step", "mln.step.tbptt"))
 
     def set_divergence_guard(self, guard) -> "MultiLayerNetwork":
         """Install a train/resilience.DivergenceGuard (None to remove).
@@ -575,10 +579,12 @@ class MultiLayerNetwork:
     def _get_step_fn(self, with_carries: bool):
         if with_carries:
             if self._tbptt_step_fn is None:
-                self._tbptt_step_fn = self._make_step(True)
+                self._tbptt_step_fn = aot.wrap(
+                    self._make_step(True), "mln.step.tbptt", model=self)
             return self._tbptt_step_fn
         if self._step_fn is None:
-            self._step_fn = self._make_step(False)
+            self._step_fn = aot.wrap(
+                self._make_step(False), "mln.step", model=self)
         return self._step_fn
 
     # -- training ----------------------------------------------------------
@@ -633,6 +639,11 @@ class MultiLayerNetwork:
         guard = getattr(self, "divergence_guard", None)
         chain_k = (self._chain_k()
                    if sgd and not self.listeners and guard is None else 0)
+        if aot.enabled() and sgd and not tbptt and chain_k <= 1:
+            # time-to-first-step becomes a warm-path number: the step
+            # executable for the exact first-batch signature is compiled
+            # (or already bundle-restored) before the epoch loop dispatches
+            aot.warm_fit(self, data, batch_size)
         try:
             for _ in range(epochs):
                 skip_n, resume_skip = resume_skip, 0
@@ -818,6 +829,21 @@ class MultiLayerNetwork:
         return total / max(nchunks, 1)
 
     # -- inference ---------------------------------------------------------
+    def _get_output_fn(self):
+        """The jitted inference entry point, AOT-wrapped so warmup
+        (``nn/aot.py``) can pre-compile every ladder bucket and bundle
+        restore can install persisted executables."""
+        if self._output_fn is None:
+            def fwd(params, state, x, fmask):
+                # python body runs once per trace → counts actual compiles
+                bucketing.telemetry().record_trace("mln.output", np.shape(x))
+                a, _, _, _, _ = self._forward(params, state, x, train=False, rngs=None,
+                                              fmask=fmask)
+                return a
+
+            self._output_fn = aot.wrap(jax.jit(fwd), "mln.output", model=self)
+        return self._output_fn
+
     def output(self, x, train: bool = False, fmask=None):
         """Final-layer post-activation output (MultiLayerNetwork.output:2005),
         jit-compiled inference path.
@@ -827,15 +853,7 @@ class MultiLayerNetwork:
         executable per bucket — inference is row-independent (BatchNorm uses
         running stats when train=False), so zero-pad rows are dead compute,
         not a numerics change. Disable via DL4J_TPU_BUCKETING=0."""
-        if self._output_fn is None:
-            def fwd(params, state, x, fmask):
-                # python body runs once per trace → counts actual compiles
-                bucketing.telemetry().record_trace("mln.output", np.shape(x))
-                a, _, _, _, _ = self._forward(params, state, x, train=False, rngs=None,
-                                              fmask=fmask)
-                return a
-
-            self._output_fn = jax.jit(fwd)
+        self._get_output_fn()
         x = _cast_input(x, self.dtype)
         fmask = jnp.asarray(fmask, self.dtype) if fmask is not None else None
         n = x.shape[0]

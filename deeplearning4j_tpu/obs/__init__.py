@@ -36,6 +36,7 @@ from deeplearning4j_tpu.obs import metrics as _metrics
 from deeplearning4j_tpu.obs import spans as _spans
 
 __all__ = [
+    "compile_span",
     "configure_event_log",
     "counter",
     "enabled",
@@ -89,6 +90,12 @@ def tracer() -> _spans.SpanTracer:
 def span(name: str, **attrs):
     """``with obs.span("mln.fit_batch"): ...`` — see obs/spans.py."""
     return _spans.tracer().span(name, **attrs)
+
+
+def compile_span(site: str, **attrs):
+    """``with obs.compile_span("mln.step"): ...`` — the ``compile`` span
+    kind aggregating all XLA compilation work (see obs/spans.py)."""
+    return _spans.compile_span(site, **attrs)
 
 
 def recent_spans(n: Optional[int] = None):

@@ -27,7 +27,7 @@ from typing import Dict, List, Optional
 
 from deeplearning4j_tpu.obs import metrics
 
-__all__ = ["SpanTracer", "tracer"]
+__all__ = ["SpanTracer", "compile_span", "tracer"]
 
 _RING = 512  # finished spans retained
 
@@ -176,3 +176,13 @@ _TRACER = SpanTracer()
 
 def tracer() -> SpanTracer:
     return _TRACER
+
+
+def compile_span(site: str, **attrs):
+    """The ``compile`` span kind: one span family for all XLA compilation
+    work — AOT warmup (``nn/aot.py``), lazy jit traces instrumented by
+    callers, bundle re-validation. The jitted site rides as an attribute so
+    every compile aggregates under the single ``compile`` series: its
+    ``wall_sum_s`` in ``obs.snapshot()`` IS the process's total compile
+    cost, the number the cold_start bench drives down."""
+    return tracer().span("compile", site=site, **attrs)

@@ -394,10 +394,18 @@ class DataParallelStep:
         in_specs = (repl, (opt_spec, dp), repl, repl, repl,
                     dp, dp, dp, dp, repl, dp)
         out_specs = (repl, (opt_spec, dp), repl, repl, repl)
-        return jax.jit(
+        from deeplearning4j_tpu.nn import aot
+
+        jitted = jax.jit(
             shard_map(call, mesh=self.mesh, in_specs=in_specs,
                       out_specs=out_specs, check_rep=False),
             donate_argnums=(0, 1, 2))
+        # the grad-exchange step is its own AOT site: the compressed/sharded
+        # exchange traces a different executable than the single-chip step,
+        # and warmup (aot.warm_dp) / bundle restore must target it. NOT
+        # registered under the model's step sites — rebuild_step()/reload()
+        # call here again and replace the wrapper wholesale.
+        return aot.wrap(jitted, "dp.step", model=self.model)
 
     # -- optimizer-state layout conversion ----------------------------------
     def _to_flat_opt(self, e: _Entry, structured):

@@ -41,15 +41,28 @@ class ParallelInference:
     one per distinct coalesced size. Defaults to the DL4J_TPU_BUCKETING env
     switch. Padded rows are zeros (inference is row-independent) and are
     sliced off before results fan back out to requesters.
+
+    ``warmup``: AOT-compile the model's inference executable for EVERY
+    bucket a coalesced batch can hit (``nn.aot.warm_serving``) before the
+    first request, so time-to-first-request never pays an XLA compile.
+    Defaults to the DL4J_TPU_AOT env switch.
     """
 
     def __init__(self, model, mode: str = "batched", max_batch_size: int = 32,
                  queue_limit: int = 64, worker: bool = True,
-                 bucket: Optional[bool] = None):
+                 bucket: Optional[bool] = None, warmup: Optional[bool] = None):
         self.model = model
         self.mode = mode
         self.max_batch_size = max_batch_size
         self.bucket = bucketing.bucketing_enabled() if bucket is None else bucket
+        if warmup is None:
+            from ..nn import aot
+
+            warmup = aot.enabled()
+        if warmup:
+            from ..nn import aot
+
+            aot.warm_serving(model, max_batch_size)
         self._queue: "queue.Queue[_Pending]" = queue.Queue(maxsize=queue_limit)
         self._carry: Optional[_Pending] = None  # request deferred by _drain
         self._stop = threading.Event()

@@ -166,6 +166,15 @@ def save_checkpoint(model, path, normalizer: Optional[dict] = None) -> dict:
                   "Wall time of durable checkpoint writes").observe(dur)
     obs.event("checkpoint_saved", path=str(path), crc=info["crc"],
               size=info["size"], duration_s=round(dur, 6))
+    # executable bundle sidecar (nn/aot.py): resume restores params AND
+    # compiled executables. save_bundle gates itself (validation-proven
+    # backends only; default off on XLA:CPU) and never raises — the
+    # checkpoint above is durable regardless of what happens here.
+    from deeplearning4j_tpu.nn import aot
+
+    bundle = aot.save_bundle(model, aot.bundle_path_for(path))
+    if bundle is not None:
+        info["aot_bundle"] = bundle
     return info
 
 
@@ -204,7 +213,15 @@ def resume(model, directory):
             f"resume_from={str(directory)!r}: no valid checkpoint found; "
             "training from the model's current state")
         return None
-    load_state_into(model, os.path.join(str(directory), cp.filename))
+    path = os.path.join(str(directory), cp.filename)
+    load_state_into(model, path)
+    # executable bundle sidecar: restore compiled executables alongside the
+    # params so the first post-resume step/request is warm. Missing file is
+    # a silent no-op; corrupt/mismatched bundles reject to recompile
+    # (never raise) — see nn/aot.py.
+    from deeplearning4j_tpu.nn import aot
+
+    aot.restore_bundle(model, aot.bundle_path_for(path))
     return cp
 
 

@@ -324,7 +324,17 @@ class UIServer:
         return "".join(parts)
 
     # -- serving -----------------------------------------------------------
-    def serve(self, port: int = 9001) -> "UIServer":
+    def serve(self, port: int = 9001, warm_models=(),
+              warm_batch: int = 32) -> "UIServer":
+        # AOT warmup BEFORE the socket binds: a server that answers its
+        # port is warm — time-to-first-request never pays an XLA compile
+        # (``warm_models``: models whose inference path this server fronts;
+        # ``warm_batch``: largest request batch to ladder-walk up to)
+        if warm_models:
+            from deeplearning4j_tpu.nn import aot
+
+            for m in warm_models:
+                aot.warm_serving(m, warm_batch)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):

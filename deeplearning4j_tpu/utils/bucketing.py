@@ -166,6 +166,15 @@ class BucketTelemetry:
             "dl4j_bucketing_traces_total",
             "XLA traces/compiles by jitted site (recorded inside traced "
             "bodies, so this counts compiles, not calls)", ("site",))
+        # the public compile counter (docs/OBSERVABILITY.md): same increment
+        # as the legacy bucketing family above, under the name dashboards and
+        # the cold_start bench key on — zero delta across a request window
+        # proves the request hit only pre-compiled executables
+        self._compiles = reg.counter(
+            "dl4j_compiles_total",
+            "XLA compiles by jitted site (every trace of a jitted body, "
+            "lazy or AOT — see dl4j_aot_warm_hits_total for AOT dispatch "
+            "hits)", ("site",))
         self._hits = reg.counter(
             "dl4j_bucketing_hits_total",
             "padded dispatches by site and bucket rung", ("site", "bucket"))
@@ -189,14 +198,15 @@ class BucketTelemetry:
 
     def reset(self):
         with self._lock:
-            for fam in (self._traces, self._hits, self._padded, self._real,
-                        self._comm, self._guard):
+            for fam in (self._traces, self._compiles, self._hits,
+                        self._padded, self._real, self._comm, self._guard):
                 fam.clear()
             self.trace_shapes = {}
 
     def record_trace(self, site: str, shape: Sequence[int]):
         with self._lock:
             self.trace_shapes.setdefault(site, set()).add(tuple(shape))
+        self._compiles.inc(site=site)
         count = self._traces.inc(site=site)
         if self._emit_events:
             from deeplearning4j_tpu import obs

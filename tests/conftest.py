@@ -36,6 +36,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 run (-m 'not slow'); covered by "
+        "the smoke scripts under tools/")
+
+
 @pytest.fixture
 def rng():
     return np.random.RandomState(12345)

@@ -29,5 +29,11 @@ no_obs = [m["metric"] for m in extras
           or not {"metrics", "spans", "events", "bucketing"} <= m["obs"].keys()]
 if no_obs:
     sys.exit(f"bench smoke: metrics missing obs snapshot: {no_obs}")
+# cold-start acceptance gates (docs/PERF.md): warm-restore TTFR strictly
+# below the lazy arm, zero compiles anywhere on the warm-restore paths
+cold = next(m for m in extras if m["metric"] == "cold_start_ttfr_ms")
+if not (cold.get("gate_ttfr_bundle_lt_none")
+        and cold.get("gate_zero_request_compiles")):
+    sys.exit(f"bench smoke: cold_start gates failed: {cold}")
 print(f"bench smoke OK: {len(extras)} metrics, no errors, obs embedded")
 EOF
