@@ -73,46 +73,27 @@ NOMINAL = {
     "word2vec_skipgram_throughput": 500_000.0,
 }
 
-# Peak dense matmul FLOP/s per chip, by device_kind substring (bf16, fp32).
-# Sources: public TPU spec sheets; CPU entry makes local runs degrade softly.
-_PEAKS = [
-    ("v6", (918e12, 459e12)),
-    ("v5p", (459e12, 459e12)),
-    ("v5 lite", (197e12, 98e12)),
-    ("v5e", (197e12, 98e12)),
-    ("v4", (275e12, 137e12)),
-    ("v3", (123e12, 61e12)),
-    ("v2", (45e12, 22e12)),
-]
-
-
 def _peak_flops(dtype: str) -> float | None:
-    import jax
+    # single source of truth for the per-backend roofline (absorbed the
+    # table this file carried since PR 3): obs/profile.py
+    from deeplearning4j_tpu.obs import profile
 
-    kind = jax.devices()[0].device_kind.lower()
-    for sub, (bf16, f32) in _PEAKS:
-        if sub in kind:
-            return bf16 if dtype == "bfloat16" else f32
-    return None  # CPU / unknown: MFU omitted
+    return profile.peak_flops(dtype)
 
 
 def _mfu_from_cost(compiled, steps_per_sec: float) -> dict:
     """MFU from XLA's own cost analysis of an AOT-compiled step against the
     bf16 roofline (jax's default TPU matmul precision multiplies f32 inputs
-    in bf16). Returns {} when unavailable."""
+    in bf16). Harvests through obs.profile so the same numbers land in the
+    cost gauges. Returns {} when unavailable."""
+    from deeplearning4j_tpu.obs import profile
+
     peak = _peak_flops("bfloat16")
-    if not peak:
+    entry = profile.harvest_compiled("bench.step", compiled, key="bench")
+    if not peak or not entry or not entry.get("flops"):
         return {}
-    try:
-        ca = compiled.cost_analysis()
-        ca = ca[0] if isinstance(ca, list) else ca
-        xla_flops = float(ca.get("flops", 0.0))
-    except Exception:
-        return {}  # cost analysis unavailable on some backends
-    if xla_flops <= 0:
-        return {}
-    return {"mfu": round(xla_flops * steps_per_sec / peak, 4),
-            "xla_gflops_per_step": round(xla_flops / 1e9, 2)}
+    return {"mfu": round(entry["flops"] * steps_per_sec / peak, 4),
+            "xla_gflops_per_step": round(entry["flops"] / 1e9, 2)}
 
 
 def _timed(run, warmup_steps: int = 5, steps: int = 30):
@@ -915,6 +896,10 @@ def bench_mnist_mlp():
         "obs_off_samples_per_sec": round(steps * batch / t_off, 1),
         "reps": len(on_times),
         "batches_per_arm": steps,
+        # resolved while the model is still alive: the lazy cost exemplars
+        # weakref the jitted step fn, so report-time resolution must happen
+        # before the bench returns and drops it
+        "cost": obs.cost_report(),
     }
 
 
@@ -990,6 +975,8 @@ def _cold_start_arm(arm: str, workdir: str) -> dict:
     ttfs_ms = 1e3 * (time.perf_counter() - t0)
     step_compiles = tel.compiles("mln.step") - c0
 
+    from deeplearning4j_tpu import obs
+
     return {
         "arm": arm,
         "startup_ms": round(startup_ms, 1),
@@ -1000,6 +987,9 @@ def _cold_start_arm(arm: str, workdir: str) -> dict:
         "restored_entries": restored,
         "validation_ms": round(validation_ms, 1),
         "persistence_validated": validated,
+        # per-arm XLA cost + roofline view, resolved while the serving and
+        # fit models are still alive (lazy exemplars weakref their targets)
+        "cost": obs.cost_report(),
     }
 
 

@@ -8,6 +8,7 @@
 | numpy-on-tracer   | traced                | TracerArrayConversionError / consts  |
 | lock-discipline   | threaded modules      | unguarded shared mutable state       |
 | monotonic-clock   | everything            | wall clock in duration arithmetic    |
+| cost-analysis-off-hot-path | traced + hot | HLO cost walk / trace export per batch |
 
 Each checker yields ``engine.Finding`` objects; inline
 ``# graftlint: disable=<rule>`` suppressions are honored by
@@ -38,6 +39,7 @@ ALL_RULES = (
     "numpy-on-tracer",
     "lock-discipline",
     "monotonic-clock",
+    "cost-analysis-off-hot-path",
 )
 
 # numpy calls that only touch metadata — safe on tracers and device arrays
@@ -71,6 +73,8 @@ def run(index: Index, rules: Optional[Sequence[str]] = None) -> List[Finding]:
         out += _rule_lock_discipline(index)
     if "monotonic-clock" in active:
         out += _rule_monotonic_clock(index)
+    if "cost-analysis-off-hot-path" in active:
+        out += _rule_cost_analysis_off_hot_path(index)
     # drop duplicates (one line can trip a rule through several sub-checks)
     seen: Set[tuple] = set()
     uniq = []
@@ -506,4 +510,54 @@ def _rule_lock_discipline(index: Index) -> List[Finding]:
                     scan(child, d)
 
             scan(fi.node, 0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# cost-analysis-off-hot-path
+# ---------------------------------------------------------------------------
+
+# trace-export entry points (obs/trace_export.py): serializing the whole span
+# ring per call — report-time surfaces only
+_TRACE_EXPORT_CALLS = {"live_trace", "trace_events"}
+
+
+def _rule_cost_analysis_off_hot_path(index: Index) -> List[Finding]:
+    """``cost_analysis()``/``memory_analysis()`` walk the lowered/compiled
+    HLO modules host-side — milliseconds per call — and the trace-export
+    helpers serialize the whole span ring. Neither belongs in traced bodies
+    (baked in at trace time, re-run per compile) or per-batch dispatch code
+    (latency per step). Harvest at compile time and render at report time
+    instead (obs/profile.py, obs/trace_export.py)."""
+    out = []
+    for q in sorted(index.traced | index.hot):
+        fi = index.functions[q]
+        where = "traced" if q in index.traced else "hot-path"
+        for node in own_nodes(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = None
+            if isinstance(node.func, ast.Attribute) and node.func.attr in (
+                    "cost_analysis", "memory_analysis"):
+                f = index.make_finding(
+                    "cost-analysis-off-hot-path", fi, node.lineno,
+                    f".{node.func.attr}() reachable from {where} code: walks "
+                    "the executable's HLO host-side (milliseconds per call); "
+                    "harvest once at compile/report time via obs.profile "
+                    "instead")
+            else:
+                d = dotted_name(node.func, fi.module) or ""
+                leaf = d.rsplit(".", 1)[-1] if d else (
+                    node.func.attr if isinstance(node.func, ast.Attribute)
+                    else node.func.id if isinstance(node.func, ast.Name)
+                    else "")
+                if leaf in _TRACE_EXPORT_CALLS or "trace_export." in d:
+                    f = index.make_finding(
+                        "cost-analysis-off-hot-path", fi, node.lineno,
+                        f"trace export ({leaf or d}) reachable from {where} "
+                        "code: serializes the span ring per call; export at "
+                        "report time (/debug/trace, DL4J_TPU_SPAN_DUMP) "
+                        "instead")
+            if f:
+                out.append(f)
     return out

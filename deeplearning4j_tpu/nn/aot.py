@@ -68,6 +68,7 @@ import numpy as np
 
 from deeplearning4j_tpu import obs
 from deeplearning4j_tpu.analysis import retrace_guard
+from deeplearning4j_tpu.obs import profile as _profile
 from deeplearning4j_tpu.utils import bucketing
 
 __all__ = [
@@ -124,6 +125,12 @@ def signature_key(args: tuple, kwargs: dict) -> Tuple:
     return (treedef, tuple(_leaf_meta(l) for l in leaves))
 
 
+def _sig_label(key: Tuple) -> str:
+    """Stable short label for a signature key (cost-model gauge label when
+    no bucket is known)."""
+    return f"sig{abs(hash(key)) % 10**8:08d}"
+
+
 # ---------------------------------------------------------------------------
 # The dispatcher
 # ---------------------------------------------------------------------------
@@ -146,15 +153,19 @@ class AotFunction:
         self._lock = threading.Lock()
 
     # -- warmup ------------------------------------------------------------
-    def warm(self, *args, **kwargs):
+    def warm(self, *args, cost_key: Optional[str] = None, **kwargs):
         """Compile (without executing) for this exact call signature and
-        cache the executable; returns the ``Compiled`` (idempotent)."""
+        cache the executable; returns the ``Compiled`` (idempotent).
+        ``cost_key`` labels the executable's cost-model gauges (warmers pass
+        the bucket, e.g. ``b64``; defaults to a signature hash)."""
         key = signature_key(args, kwargs)
         existing = self._compiled.get(key)
         if existing is not None:
             return existing
         with obs.compile_span(self.site, mode="aot"):
             compiled = self._jit.lower(*args, **kwargs).compile()
+        _profile.harvest_compiled(
+            self.site, compiled, key=cost_key or _sig_label(key))
         with self._lock:
             # a concurrent warm of the same key wastes one compile at worst
             self._compiled.setdefault(key, compiled)
@@ -164,6 +175,7 @@ class AotFunction:
         """Adopt an already-built executable (bundle restore path)."""
         with self._lock:
             self._compiled[key] = compiled
+        _profile.harvest_compiled(self.site, compiled, key=_sig_label(key))
 
     @property
     def compiled_count(self) -> int:
@@ -190,13 +202,23 @@ class AotFunction:
                         "dl4j_aot_dispatch_fallbacks_total",
                         "AOT executables evicted on dispatch mismatch",
                         ("site",)).inc(site=self.site)
-                    return self._jit(*args, **kwargs)
+                    return self._lazy(args, kwargs)
                 obs.counter(
                     "dl4j_aot_warm_hits_total",
                     "dispatches served by an AOT/bundle-restored executable",
                     ("site",)).inc(site=self.site)
                 return out
-        return self._jit(*args, **kwargs)
+        return self._lazy(args, kwargs)
+
+    def _lazy(self, args, kwargs):
+        out = self._jit(*args, **kwargs)
+        # a compile just happened on this dispatch iff record_trace flagged
+        # the site during tracing; capture its abstract signature so
+        # cost_report() can price the executable later. One set lookup on
+        # the warm path, aval capture only on the (rare) compile path.
+        if _profile.wants_exemplar(self.site):
+            _profile.note_exemplar(self.site, self, args, kwargs)
+        return out
 
     # convenience parity with jax.jit objects used elsewhere
     def lower(self, *args, **kwargs):
@@ -290,9 +312,10 @@ def warm_serving(model, max_batch: int,
     for b in buckets:
         feats = _dummy_features(model, b)
         if is_graph:
-            fn.warm(model.params, model.state, model._input_dict(feats), None)
+            fn.warm(model.params, model.state, model._input_dict(feats), None,
+                    cost_key=f"b{b}")
         else:
-            fn.warm(model.params, model.state, feats, None)
+            fn.warm(model.params, model.state, feats, None, cost_key=f"b{b}")
     retrace_guard.register_aot_warmed(site, buckets)
     obs.event("aot_warmup", site=site, buckets=list(buckets),
               executables=fn.compiled_count,
@@ -351,6 +374,7 @@ def warm_fit(model, data, batch_size: Optional[int] = None) -> int:
     step = model._get_step_fn(False)
     before = step.compiled_count
     t0 = time.perf_counter()
+    bucket = pad_target if pad_target is not None else len(x)
     step.warm(
         model.params, model.opt_state, model.state,
         jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
@@ -358,8 +382,8 @@ def warm_fit(model, data, batch_size: Optional[int] = None) -> int:
         jnp.asarray(fm, model.dtype) if fm is not None else None,
         jnp.asarray(lm, model.dtype) if lm is not None else None, (),
         ex_weight=jnp.asarray(ew, model.dtype) if ew is not None else None,
+        cost_key=f"b{bucket}",
     )
-    bucket = pad_target if pad_target is not None else len(x)
     retrace_guard.register_aot_warmed("mln.step", [bucket])
     obs.event("aot_warmup", site="mln.step", buckets=[int(bucket)],
               executables=step.compiled_count,
@@ -395,13 +419,14 @@ def _warm_fit_graph(model, data, batch_size: Optional[int]) -> int:
     step = model._get_step_fn(False)
     before = step.compiled_count
     t0 = time.perf_counter()
+    bucket = pad_target if pad_target is not None else b
     step.warm(
         model.params, model.opt_state, model.state,
         jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
         model._input_dict(f), l, model._mask_dict(fm), lm, {},
         ex_weight=jnp.asarray(ew, model.dtype) if ew is not None else None,
+        cost_key=f"b{bucket}",
     )
-    bucket = pad_target if pad_target is not None else b
     retrace_guard.register_aot_warmed("cg.step", [bucket])
     obs.event("aot_warmup", site="cg.step", buckets=[int(bucket)],
               executables=step.compiled_count,
@@ -426,13 +451,15 @@ def warm_dp(runner, x, y, fm=None, lm=None, ew=None) -> int:
     step = runner._step
     before = step.compiled_count
     t0 = time.perf_counter()
+    bucket = len(x[0] if runner.is_graph else x)
     if runner.is_graph:
         f = tuple(_cast_input(a, model.dtype) for a in x)
         step.warm(
             model.params, (runner._opt_flat, runner._residual), model.state,
             jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0),
             model._input_dict(f), y, model._mask_dict(fm), lm, {},
-            jnp.asarray(ew, model.dtype) if ew is not None else None)
+            jnp.asarray(ew, model.dtype) if ew is not None else None,
+            cost_key=f"b{bucket}")
         site = "cg.step"
     else:
         step.warm(
@@ -441,9 +468,9 @@ def warm_dp(runner, x, y, fm=None, lm=None, ew=None) -> int:
             _cast_input(x, model.dtype), _cast_labels(y, model.dtype),
             jnp.asarray(fm, model.dtype) if fm is not None else None,
             jnp.asarray(lm, model.dtype) if lm is not None else None, (),
-            jnp.asarray(ew, model.dtype) if ew is not None else None)
+            jnp.asarray(ew, model.dtype) if ew is not None else None,
+            cost_key=f"b{bucket}")
         site = "mln.step"
-    bucket = len(x[0] if runner.is_graph else x)
     retrace_guard.register_aot_warmed(site, [bucket])
     obs.event("aot_warmup", site="dp.step", buckets=[int(bucket)],
               executables=step.compiled_count,

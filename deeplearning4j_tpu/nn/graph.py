@@ -892,7 +892,6 @@ class ComputationGraph:
         from deeplearning4j_tpu.train import resilience
 
         order = self.topo_order
-        updaters = self._updaters
         # divergence-guard skip_batch: the accept/reject select is traced
         # INTO the step (device-side; no extra host sync)
         guard = getattr(self, "divergence_guard", None)
@@ -913,8 +912,11 @@ class ComputationGraph:
                                   ex_weight=ex_weight,
                                   carries=carries if with_carries else None)
 
-            ((loss, (new_state, new_carries)), grads) = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
+            # trace-time phase spans: fire once per compile, attributing
+            # trace cost per phase (runtime attribution: DL4J_TPU_PHASE_SPANS)
+            with obs.span("phase.bwd", mode="trace"):
+                ((loss, (new_state, new_carries)), grads) = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
             if grad_exchange is not None:
                 loss = grad_exchange.mean_loss(loss)
                 new_state = grad_exchange.mean_state(new_state)
@@ -929,29 +931,9 @@ class ComputationGraph:
                     new_state = resilience.guard_select(ok, new_state, state)
                 return (new_params, (new_opt, new_res), new_state,
                         new_carries, loss)
-            new_params, new_opt = {}, {}
-            for name in order:
-                g = grads[name]
-                if not g:
-                    new_params[name] = params[name]
-                    new_opt[name] = opt_state[name]
-                    continue
-                cfg = self.rt[name].config
-                gn = getattr(cfg, "gradient_normalization", None)
-                if gn:
-                    g = apply_gradient_normalization(
-                        gn, getattr(cfg, "gradient_normalization_threshold", 1.0), g
-                    )
-                upd, ns = updaters[name].update(g, opt_state[name], params[name], it)
-                p_new = jax.tree_util.tree_map(
-                    lambda p, d: p - d, params[name], upd
-                )
-                if getattr(cfg, "constraints", None):
-                    from deeplearning4j_tpu.nn.constraints import apply_constraints
-
-                    p_new = apply_constraints(cfg, p_new)
-                new_params[name] = p_new
-                new_opt[name] = ns
+            with obs.span("phase.update", mode="trace"):
+                new_params, new_opt = self._update_params(
+                    params, opt_state, grads, it)
             if g_skip:
                 ok = resilience.guard_ok(loss, g_limit)
                 new_params = resilience.guard_select(ok, new_params, params)
@@ -960,6 +942,37 @@ class ComputationGraph:
             return new_params, new_opt, new_state, new_carries, loss
 
         return step
+
+    def _update_params(self, params, opt_state, grads, it):
+        """Per-vertex optimizer update (normalization → updater →
+        constraints), shared by the fused step body and the split-dispatch
+        phase mode so both paths run identical math."""
+        order = self.topo_order
+        updaters = self._updaters
+        new_params, new_opt = {}, {}
+        for name in order:
+            g = grads[name]
+            if not g:
+                new_params[name] = params[name]
+                new_opt[name] = opt_state[name]
+                continue
+            cfg = self.rt[name].config
+            gn = getattr(cfg, "gradient_normalization", None)
+            if gn:
+                g = apply_gradient_normalization(
+                    gn, getattr(cfg, "gradient_normalization_threshold", 1.0), g
+                )
+            upd, ns = updaters[name].update(g, opt_state[name], params[name], it)
+            p_new = jax.tree_util.tree_map(
+                lambda p, d: p - d, params[name], upd
+            )
+            if getattr(cfg, "constraints", None):
+                from deeplearning4j_tpu.nn.constraints import apply_constraints
+
+                p_new = apply_constraints(cfg, p_new)
+            new_params[name] = p_new
+            new_opt[name] = ns
+        return new_params, new_opt
 
     def _get_step_fn(self, with_carries: bool):
         if with_carries:

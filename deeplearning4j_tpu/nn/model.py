@@ -176,10 +176,15 @@ _CHAIN_RNG_WARNED = False
 def _chain_k_from_env(uses_rng: bool, n_params: int) -> int:
     """Shared chained-fit gate for MultiLayerNetwork and ComputationGraph:
     DL4J_TPU_CHAIN_STEPS forces a count (0 disables); "auto" chains 8 only
-    for rng-free models small enough to be dispatch-bound."""
+    for rng-free models small enough to be dispatch-bound. Phase-span
+    profiling (DL4J_TPU_PHASE_SPANS=1) disables auto-chaining: its whole
+    point is per-phase dispatch, which a K-step chain would hide — an
+    explicit DL4J_TPU_CHAIN_STEPS count still wins."""
     import os as _os
 
     env = _os.environ.get("DL4J_TPU_CHAIN_STEPS", "auto")
+    if env == "auto" and obs.phase_spans_enabled():
+        return 0
     if env != "auto":
         try:
             k = max(int(env), 0)
@@ -473,7 +478,6 @@ class MultiLayerNetwork:
         step's signature and return arity are unchanged."""
         from deeplearning4j_tpu.train import resilience
 
-        updaters = self._updaters
         layers = self.layers
         # divergence-guard skip_batch: the accept/reject select is traced
         # INTO the step (device-side; no extra host sync)
@@ -494,9 +498,15 @@ class MultiLayerNetwork:
                                   carries if with_carries else None,
                                   ex_weight=ex_weight)
 
-            (loss, (new_state, new_carries)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(params)
+            # phase spans here run at TRACE time (the python body executes
+            # once per compile): they attribute compile cost per phase and
+            # nest under the enclosing fit/compile span in the trace export.
+            # Runtime per-phase wall time needs the split-dispatch mode
+            # (DL4J_TPU_PHASE_SPANS=1, _fit_batch_phases).
+            with obs.span("phase.bwd", mode="trace"):
+                (loss, (new_state, new_carries)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params)
 
             if grad_exchange is not None:
                 loss = grad_exchange.mean_loss(loss)
@@ -513,29 +523,9 @@ class MultiLayerNetwork:
                 return (new_params, (new_opt, new_res), new_state,
                         new_carries, loss)
 
-            new_params = []
-            new_opt = []
-            for i, (u, layer) in enumerate(zip(updaters, layers)):
-                g = grads[i]
-                if not g:  # param-free layer
-                    new_params.append(params[i])
-                    new_opt.append(opt_state[i])
-                    continue
-                gn = getattr(layer, "gradient_normalization", None)
-                if gn:
-                    g = apply_gradient_normalization(
-                        gn, getattr(layer, "gradient_normalization_threshold", 1.0), g
-                    )
-                upd, new_s = u.update(g, opt_state[i], params[i], it)
-                p_new = jax.tree_util.tree_map(lambda p, d: p - d, params[i], upd)
-                if getattr(layer, "constraints", None):
-                    # post-update projection, fused into the same executable
-                    from deeplearning4j_tpu.nn.constraints import apply_constraints
-
-                    p_new = apply_constraints(layer, p_new)
-                new_params.append(p_new)
-                new_opt.append(new_s)
-            out_params, out_opt = tuple(new_params), tuple(new_opt)
+            with obs.span("phase.update", mode="trace"):
+                out_params, out_opt = self._update_params(
+                    params, opt_state, grads, it)
             if g_skip:
                 ok = resilience.guard_ok(loss, g_limit)
                 out_params = resilience.guard_select(ok, out_params, params)
@@ -544,6 +534,103 @@ class MultiLayerNetwork:
             return out_params, out_opt, new_state, new_carries, loss
 
         return step
+
+    def _update_params(self, params, opt_state, grads, it):
+        """The per-layer optimizer update (normalization → updater →
+        constraints), shared by the fused step body and the split-dispatch
+        phase mode so both paths run identical math."""
+        new_params = []
+        new_opt = []
+        for i, (u, layer) in enumerate(zip(self._updaters, self.layers)):
+            g = grads[i]
+            if not g:  # param-free layer
+                new_params.append(params[i])
+                new_opt.append(opt_state[i])
+                continue
+            gn = getattr(layer, "gradient_normalization", None)
+            if gn:
+                g = apply_gradient_normalization(
+                    gn, getattr(layer, "gradient_normalization_threshold", 1.0), g
+                )
+            upd, new_s = u.update(g, opt_state[i], params[i], it)
+            p_new = jax.tree_util.tree_map(lambda p, d: p - d, params[i], upd)
+            if getattr(layer, "constraints", None):
+                # post-update projection, fused into the same executable
+                from deeplearning4j_tpu.nn.constraints import apply_constraints
+
+                p_new = apply_constraints(layer, p_new)
+            new_params.append(p_new)
+            new_opt.append(new_s)
+        return tuple(new_params), tuple(new_opt)
+
+    # -- split-dispatch phase profiling ------------------------------------
+    def _make_phase_fns(self):
+        """Three executables for the DL4J_TPU_PHASE_SPANS=1 profiling mode:
+        forward-only loss, value_and_grad (its forward recompute is the
+        price of splitting — bwd wall includes one fwd), and the optimizer
+        update. Same loss/update code as the fused step; the same rng key
+        feeds fwd and bwd so both see identical dropout draws. Nothing
+        donates: arguments are re-used across phases, and a profiling mode
+        measures wall time, not allocator behavior."""
+        layers = self.layers
+
+        def fwd(params, state, x, y, fmask, lmask, rng, ex_weight):
+            bucketing.telemetry().record_trace("mln.phase.fwd", np.shape(x))
+            rngs = list(jax.random.split(rng, len(layers)))
+            loss, _ = self._loss(params, state, x, y, fmask, lmask, rngs,
+                                 None, ex_weight=ex_weight)
+            return loss
+
+        def bwd(params, state, x, y, fmask, lmask, rng, ex_weight):
+            bucketing.telemetry().record_trace("mln.phase.bwd", np.shape(x))
+            rngs = list(jax.random.split(rng, len(layers)))
+
+            def loss_fn(p):
+                return self._loss(p, state, x, y, fmask, lmask, rngs, None,
+                                  ex_weight=ex_weight)
+
+            (loss, (new_state, _)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            return loss, new_state, grads
+
+        def upd(params, opt_state, grads, it):
+            bucketing.telemetry().record_trace("mln.phase.update", ())
+            return self._update_params(params, opt_state, grads, it)
+
+        return jax.jit(fwd), jax.jit(bwd), jax.jit(upd)
+
+    def _get_phase_fns(self):
+        if getattr(self, "_phase_fns", None) is None:
+            self._phase_fns = self._make_phase_fns()
+        return self._phase_fns
+
+    def _fit_batch_phases(self, x, y, fm, lm, ew):
+        """One training step as three blocked dispatches under nested
+        phase.fwd/phase.bwd/phase.update spans (inside the caller's
+        mln.fit_batch span). The block_until_ready barriers are the POINT
+        of this mode — per-phase wall times instead of one fused opaque
+        dispatch — and also why it is opt-in: blocking forfeits pipeline
+        overlap, so it profiles, never trains by default. Parameter math is
+        identical to the fused step; the divergence-guard fused select and
+        grad-exchange variants fall back to the fused path in _fit_batch."""
+        fwd, bwd, upd = self._get_phase_fns()
+        it = jnp.asarray(self.iteration, jnp.int32)
+        rng = self._next_rng()
+        ew_a = jnp.asarray(ew, self.dtype) if ew is not None else None
+        with obs.span("phase.fwd"):
+            loss_fwd = fwd(self.params, self.state, x, y, fm, lm, rng, ew_a)
+            jax.block_until_ready(loss_fwd)
+        with obs.span("phase.bwd"):
+            loss, new_state, grads = bwd(
+                self.params, self.state, x, y, fm, lm, rng, ew_a)
+            jax.block_until_ready(grads)
+        with obs.span("phase.update"):
+            self.params, self.opt_state = upd(
+                self.params, self.opt_state, grads, it)
+            jax.block_until_ready(self.params)
+        self.state = new_state
+        self.iteration += 1
+        return loss
 
     def _make_chain_step(self):
         """K train steps per DISPATCH: lax.scan of the step body over
@@ -556,6 +643,11 @@ class MultiLayerNetwork:
         body_step = self._step_body(False)
 
         def chain(params, opt_state, state, it0, rng, xs, ys):
+            # own cost-attribution site: the chained executable covers K
+            # steps per dispatch, so its static costs must not be filed
+            # under the per-step mln.step site
+            bucketing.telemetry().record_trace("mln.chain", np.shape(xs))
+
             def body(carry, inp):
                 p, o, s, i = carry
                 x, y = inp
@@ -606,9 +698,17 @@ class MultiLayerNetwork:
         chain = self._get_chain_step()
         xs = jnp.stack([_cast_input(x, self.dtype) for x, _ in buf])
         ys = jnp.stack([_cast_labels(y, self.dtype) for _, y in buf])
-        self.params, self.opt_state, self.state, _ = chain(
-            self.params, self.opt_state, self.state,
-            jnp.asarray(self.iteration, jnp.int32), self._next_rng(), xs, ys)
+        args = (self.params, self.opt_state, self.state,
+                jnp.asarray(self.iteration, jnp.int32), self._next_rng(),
+                xs, ys)
+        self.params, self.opt_state, self.state, _ = chain(*args)
+        # chained dispatches bypass AotFunction, so the lazy cost harvest
+        # hooks in here: aval capture only on the (rare) compile path —
+        # donation invalidates buffers, not shapes/dtypes
+        from deeplearning4j_tpu.obs import profile as _profile
+
+        if _profile.wants_exemplar("mln.chain"):
+            _profile.note_exemplar("mln.chain", chain, args, {})
         self.iteration += len(buf)
 
     def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None,
@@ -756,11 +856,17 @@ class MultiLayerNetwork:
             chaos.maybe_preempt(self.iteration)
             chaos.maybe_slow(self.iteration)
             x = chaos.maybe_nan_batch(self.iteration, x)
-        step = self._get_step_fn(False)
         x = _cast_input(x, self.dtype)
         y = _cast_labels(y, self.dtype)
         fm = jnp.asarray(fm, self.dtype) if fm is not None else None
         lm = jnp.asarray(lm, self.dtype) if lm is not None else None
+        if (obs.phase_spans_enabled()
+                and getattr(self, "divergence_guard", None) is None):
+            # opt-in profiling mode: three blocked dispatches under nested
+            # phase spans; the fused step (guard select, donation, chaining)
+            # stays the production path
+            return self._fit_batch_phases(x, y, fm, lm, ew)
+        step = self._get_step_fn(False)
         self.params, self.opt_state, self.state, _, loss = step(
             self.params, self.opt_state, self.state,
             jnp.asarray(self.iteration, jnp.int32), self._next_rng(),

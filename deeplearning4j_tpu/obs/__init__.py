@@ -38,16 +38,20 @@ from deeplearning4j_tpu.obs import spans as _spans
 __all__ = [
     "compile_span",
     "configure_event_log",
+    "cost_report",
     "counter",
     "enabled",
     "event",
     "event_log",
     "gauge",
     "histogram",
+    "observe_request",
+    "phase_spans_enabled",
     "prometheus_text",
     "recent_spans",
     "registry",
     "reset",
+    "save_spans",
     "snapshot",
     "span",
     "tracer",
@@ -57,6 +61,14 @@ __all__ = [
 def enabled() -> bool:
     """Master switch (default on). Read per call so tests can flip it."""
     return os.environ.get("DL4J_TPU_OBS", "1") != "0"
+
+
+def phase_spans_enabled() -> bool:
+    """Opt-in split-dispatch profiling mode (DL4J_TPU_PHASE_SPANS=1): the
+    fit loops dispatch fwd/bwd/update as separate blocked executables so
+    nested phase spans carry real per-phase wall time. Costs pipeline
+    overlap — a profiling mode, never the default. Implies enabled()."""
+    return enabled() and os.environ.get("DL4J_TPU_PHASE_SPANS", "0") == "1"
 
 
 # -- metrics ----------------------------------------------------------------
@@ -78,6 +90,14 @@ def histogram(name: str, help: str = "", label_names=()) -> _metrics.Histogram:
 
 
 def prometheus_text() -> str:
+    # exposition is report-time: resolve pending lazy cost signatures first
+    # so the XLA cost / MFU gauges reflect every compile seen so far
+    try:
+        from deeplearning4j_tpu.obs import profile as _profile
+
+        _profile.snapshot()
+    except Exception:
+        pass
     return _metrics.registry().prometheus_text()
 
 
@@ -100,6 +120,31 @@ def compile_span(site: str, **attrs):
 
 def recent_spans(n: Optional[int] = None):
     return _spans.tracer().recent(n)
+
+
+def save_spans(path: str) -> int:
+    """Dump the span ring + timeline anchor as JSON for offline trace
+    export (also available via DL4J_TPU_SPAN_DUMP at exit)."""
+    return _spans.tracer().dump(path)
+
+
+# -- profiling / SLOs -------------------------------------------------------
+
+def cost_report(resolve: bool = True) -> dict:
+    """XLA static costs + roofline utilization (see obs/profile.py).
+    Report-time only — resolution may lower pending lazy signatures."""
+    from deeplearning4j_tpu.obs import profile as _profile
+
+    return _profile.cost_report(resolve=resolve)
+
+
+def observe_request(route: str, latency_s: float, status: str = "ok",
+                    error: bool = False):
+    """Record one serving/HTTP request against the SLO tracker
+    (see obs/slo.py). No-op when DL4J_TPU_OBS=0; never raises."""
+    from deeplearning4j_tpu.obs import slo as _slo
+
+    _slo.observe_request(route, latency_s, status=status, error=error)
 
 
 # -- events -----------------------------------------------------------------
@@ -125,6 +170,7 @@ def snapshot() -> dict:
     families (counters/gauges plain, histograms summarized), per-span
     aggregates, and event counts. Embedded in bench.py result JSON and in
     the resilience checkpoint telemetry field (round-trips through JSON)."""
+    from deeplearning4j_tpu.obs import profile as _profile
     from deeplearning4j_tpu.utils import bucketing
 
     return {
@@ -132,11 +178,18 @@ def snapshot() -> dict:
         "spans": _spans.tracer().summary(),
         "events": _events.event_log().counts(),
         "bucketing": bucketing.telemetry().snapshot(),
+        "profile": _profile.snapshot(),
     }
 
 
 def reset():
-    """Zero every metric series, drop recent spans, keep configuration
-    (event-log path, family registrations). Tests and bench isolation."""
+    """Zero every metric series, drop recent spans and the cost ledger,
+    keep configuration (event-log path, family registrations). Tests and
+    bench isolation."""
+    from deeplearning4j_tpu.obs import profile as _profile
+    from deeplearning4j_tpu.obs import slo as _slo
+
     _metrics.registry().reset()
     _spans.tracer().clear()
+    _profile.reset()
+    _slo._reset_tracker()

@@ -33,7 +33,86 @@ __all__ = [
     "registry",
 ]
 
-_RESERVOIR = 256  # recent observations kept per histogram series
+_RESERVOIR = 256  # recent observations kept per histogram series (debug view)
+
+# Quantiles tracked per histogram series via P² estimators (streaming, O(1)
+# memory per quantile — serving SLOs need p95/p99 that stay correct over
+# millions of observations, which the bounded recent-window reservoir
+# cannot provide).
+_QUANTILES = (0.50, 0.90, 0.95, 0.99)
+
+
+class _P2Quantile:
+    """P² streaming quantile estimator (Jain & Chlamtac, CACM 1985).
+
+    Five markers track (min, p/2, p, (1+p)/2, max); marker heights are
+    nudged by parabolic (falling back to linear) interpolation as counts
+    drift from their desired positions. O(1) memory and O(1) update —
+    exact for the first five observations, within a fraction of a percent
+    of the true quantile for well-behaved streams after that."""
+
+    __slots__ = ("p", "n", "q", "pos")
+
+    def __init__(self, p: float):
+        self.p = p
+        self.n = 0
+        self.q: List[float] = []      # marker heights (sorted)
+        self.pos = [0, 1, 2, 3, 4]    # marker positions (0-based)
+
+    def add(self, x: float):
+        if self.n < 5:
+            self.q.append(x)
+            self.q.sort()
+            self.n += 1
+            return
+        q, pos, p = self.q, self.pos, self.p
+        if x < q[0]:
+            q[0] = x
+            k = 0
+        elif x >= q[4]:
+            q[4] = x
+            k = 3
+        else:
+            k = 0
+            for i in range(1, 4):
+                if x < q[i]:
+                    break
+                k = i
+        self.n += 1
+        for i in range(k + 1, 5):
+            pos[i] += 1
+        last = self.n - 1
+        desired = (0.0, last * p / 2.0, last * p,
+                   last * (1.0 + p) / 2.0, float(last))
+        for i in (1, 2, 3):
+            d = desired[i] - pos[i]
+            if (d >= 1.0 and pos[i + 1] - pos[i] > 1) or \
+                    (d <= -1.0 and pos[i - 1] - pos[i] < -1):
+                s = 1 if d >= 1.0 else -1
+                qn = self._parabolic(i, s)
+                if not (q[i - 1] < qn < q[i + 1]):
+                    qn = self._linear(i, s)
+                q[i] = qn
+                pos[i] += s
+
+    def _parabolic(self, i: int, s: int) -> float:
+        q, n = self.q, self.pos
+        return q[i] + s / (n[i + 1] - n[i - 1]) * (
+            (n[i] - n[i - 1] + s) * (q[i + 1] - q[i]) / (n[i + 1] - n[i])
+            + (n[i + 1] - n[i] - s) * (q[i] - q[i - 1]) / (n[i] - n[i - 1]))
+
+    def _linear(self, i: int, s: int) -> float:
+        q, n = self.q, self.pos
+        return q[i] + s * (q[i + s] - q[i]) / (n[i + s] - n[i])
+
+    def value(self) -> float:
+        if not self.q:
+            return 0.0
+        if self.n < 5:
+            # exact small-sample quantile (nearest-rank on the sorted list)
+            idx = min(len(self.q) - 1, int(self.p * len(self.q)))
+            return self.q[idx]
+        return self.q[2]
 
 
 def _label_key(label_names: Tuple[str, ...], labels: Dict[str, object]) -> Tuple[str, ...]:
@@ -109,7 +188,7 @@ class Gauge(_Family):
 
 
 class _HistSeries:
-    __slots__ = ("count", "total", "min", "max", "reservoir")
+    __slots__ = ("count", "total", "min", "max", "reservoir", "quantiles")
 
     def __init__(self):
         self.count = 0
@@ -117,12 +196,14 @@ class _HistSeries:
         self.min = float("inf")
         self.max = float("-inf")
         self.reservoir = deque(maxlen=_RESERVOIR)
+        self.quantiles = tuple(_P2Quantile(p) for p in _QUANTILES)
 
 
 class Histogram(_Family):
-    """count/sum/min/max exactly + a bounded reservoir of the most recent
-    observations for approximate quantiles. Rendered as a Prometheus
-    summary (quantile series + _sum/_count)."""
+    """count/sum/min/max exactly, P² streaming estimators for
+    p50/p90/p95/p99 over the whole stream, plus a bounded reservoir of the
+    most recent observations (debug view via ``recent``). Rendered as a
+    Prometheus summary (quantile series + _sum/_count)."""
 
     kind = "histogram"
 
@@ -140,6 +221,8 @@ class Histogram(_Family):
             if v > s.max:
                 s.max = v
             s.reservoir.append(v)
+            for est in s.quantiles:
+                est.add(v)
 
     def summary(self, **labels) -> Optional[dict]:
         key = _label_key(self.label_names, labels)
@@ -151,17 +234,15 @@ class Histogram(_Family):
 
     @staticmethod
     def _summarize(s: "_HistSeries") -> dict:
-        res = sorted(s.reservoir)
-        q = lambda p: res[min(len(res) - 1, int(p * len(res)))] if res else 0.0
-        return {
+        out = {
             "count": s.count,
             "sum": s.total,
             "min": s.min if s.count else 0.0,
             "max": s.max if s.count else 0.0,
-            "p50": q(0.50),
-            "p90": q(0.90),
-            "p99": q(0.99),
         }
+        for est in s.quantiles:
+            out[f"p{int(est.p * 100)}"] = est.value()
+        return out
 
     def as_dict(self) -> Dict[Tuple[str, ...], dict]:
         with self._lock:
@@ -239,7 +320,7 @@ class MetricsRegistry:
                 if isinstance(fam, Histogram):
                     s = fam.summary(**labels)
                     for qname, qval in (("0.5", s["p50"]), ("0.9", s["p90"]),
-                                        ("0.99", s["p99"])):
+                                        ("0.95", s["p95"]), ("0.99", s["p99"])):
                         lines.append(_sample(fam.name, {**labels, "quantile": qname}, qval))
                     lines.append(_sample(fam.name + "_sum", labels, s["sum"]))
                     lines.append(_sample(fam.name + "_count", labels, s["count"]))

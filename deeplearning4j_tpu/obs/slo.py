@@ -1,0 +1,138 @@
+"""Serving SLO instrumentation: latency, saturation, and burn rate.
+
+One :class:`SloTracker` per process watches every request path (HTTP routes
+on ``ui/server.py``, the ``ParallelInference`` serving queue) and maintains,
+per route:
+
+- ``dl4j_request_seconds{route}``     — latency histogram whose P² streaming
+  quantiles (p50/p95/p99, obs/metrics.py) stay accurate over the whole
+  stream, not just a recent window;
+- ``dl4j_requests_total{route,status}`` — request counter (``status`` is the
+  HTTP status class or ``ok``/``error`` for non-HTTP paths);
+- ``dl4j_slo_burn_rate{route}``       — how fast the route is spending its
+  error budget over a sliding window: ``bad_fraction / (1 - objective)``.
+  1.0 = burning budget exactly as fast as the objective allows; >1 = paging
+  territory; 0 = clean window. A request is *bad* when it errors or its
+  latency exceeds the threshold.
+
+Knobs (read at tracker construction): ``DL4J_TPU_SLO_LATENCY_MS`` (latency
+threshold, default 250), ``DL4J_TPU_SLO_OBJECTIVE`` (good-request
+objective, default 0.99), ``DL4J_TPU_SLO_WINDOW_S`` (sliding window,
+default 300).
+
+Gauges for saturation live next to the code that owns the resource:
+``dl4j_serving_queue_depth`` / ``dl4j_serving_in_flight``
+(``parallel/inference.py``) and ``dl4j_http_in_flight`` (``ui/server.py``).
+
+Recording is host-side arithmetic on ``perf_counter`` scalars under a lock
+— O(1) amortized per request (stale-window eviction is paid incrementally
+by the requests that observe it). Rides the ``DL4J_TPU_OBS=0`` kill switch.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, Optional, Tuple
+
+from deeplearning4j_tpu.obs import metrics
+
+__all__ = ["SloTracker", "slo_tracker", "observe_request"]
+
+
+class SloTracker:
+    def __init__(self,
+                 reg: Optional[metrics.MetricsRegistry] = None,
+                 threshold_s: Optional[float] = None,
+                 objective: Optional[float] = None,
+                 window_s: Optional[float] = None):
+        self._reg = reg or metrics.registry()
+        env = os.environ.get
+        if threshold_s is None:
+            threshold_s = float(env("DL4J_TPU_SLO_LATENCY_MS", "250")) / 1e3
+        if objective is None:
+            objective = float(env("DL4J_TPU_SLO_OBJECTIVE", "0.99"))
+        if window_s is None:
+            window_s = float(env("DL4J_TPU_SLO_WINDOW_S", "300"))
+        self.threshold_s = threshold_s
+        self.objective = min(max(objective, 0.0), 0.999999)
+        self.window_s = window_s
+        self._hist = self._reg.histogram(
+            "dl4j_request_seconds",
+            "request latency by route (P² streaming quantiles; serving SLO "
+            "source of truth)", ("route",))
+        self._count = self._reg.counter(
+            "dl4j_requests_total", "requests by route and status class",
+            ("route", "status"))
+        self._burn = self._reg.gauge(
+            "dl4j_slo_burn_rate",
+            "error-budget burn rate over the sliding window: bad_fraction / "
+            "(1 - objective); 1.0 = spending budget exactly at the "
+            "objective rate", ("route",))
+        self._lock = threading.Lock()
+        # route -> deque[(perf_counter_ts, is_bad)]
+        self._windows: Dict[str, Deque[Tuple[float, bool]]] = {}
+
+    def observe(self, route: str, latency_s: float, status: str = "ok",
+                error: bool = False):
+        """Record one finished request. Never raises (the serving path must
+        not die to bookkeeping)."""
+        try:
+            self._hist.observe(latency_s, route=route)
+            self._count.inc(route=route, status=status)
+            bad = error or latency_s > self.threshold_s
+            now = time.perf_counter()
+            horizon = now - self.window_s
+            with self._lock:
+                win = self._windows.get(route)
+                if win is None:
+                    win = self._windows[route] = deque()
+                win.append((now, bad))
+                while win and win[0][0] < horizon:
+                    win.popleft()
+                n_bad = sum(1 for _, b in win if b)
+                rate = (n_bad / len(win)) / (1.0 - self.objective)
+            self._burn.set(round(rate, 4), route=route)
+        except Exception:
+            pass
+
+    def burn_rate(self, route: str) -> Optional[float]:
+        return self._burn.value(route=route)
+
+    def clear(self):
+        with self._lock:
+            self._windows.clear()
+
+
+_TRACKER: Optional[SloTracker] = None
+_TRACKER_LOCK = threading.Lock()
+
+
+def slo_tracker() -> SloTracker:
+    """Process-global tracker, constructed on first use so env knobs set by
+    tests/launchers before the first request are honored."""
+    global _TRACKER
+    if _TRACKER is None:
+        with _TRACKER_LOCK:
+            if _TRACKER is None:
+                _TRACKER = SloTracker()
+    return _TRACKER
+
+
+def observe_request(route: str, latency_s: float, status: str = "ok",
+                    error: bool = False):
+    """Module-level convenience; honors the DL4J_TPU_OBS kill switch."""
+    from deeplearning4j_tpu import obs
+
+    if obs.enabled():
+        slo_tracker().observe(route, latency_s, status=status, error=error)
+
+
+def _reset_tracker():
+    """Drop the global tracker so the next request re-reads env knobs
+    (obs.reset; the registry families are cleared separately)."""
+    global _TRACKER
+    with _TRACKER_LOCK:
+        _TRACKER = None

@@ -16,10 +16,29 @@ Nesting is tracked per thread: a span opened while another is active records
 the outer span's name as ``parent`` and its own ``depth``. Finished spans go
 to a bounded ring buffer (most recent last) and into the
 ``dl4j_span_seconds`` histogram family in the metrics registry.
+
+Ring records carry everything ``obs/trace_export.py`` needs to render a
+Chrome/Perfetto timeline: ``t0_s`` (span start on the process-local
+``perf_counter`` timeline), ``tid``/``thread`` (OS thread identity for
+per-thread lanes), and the tracer's ``anchor()`` maps that timeline onto
+wall-clock so event-log instants (whose ``ts`` is wall-clock by design)
+land on the same axis.
+
+Ring capacity defaults to 512 finished spans and is tunable via
+``DL4J_TPU_SPAN_RING`` (read at tracer construction, i.e. first import of
+the obs layer). Overflow is NOT silent: every record evicted to make room
+increments ``dl4j_spans_dropped_total`` — mirroring the
+``dl4j_events_dropped_total`` discipline — so a long fit that outruns the
+ring is visible in /metrics instead of producing quietly truncated traces.
+``DL4J_TPU_SPAN_DUMP=<path>`` dumps the ring (plus the anchor) as JSON at
+interpreter exit for offline trace export.
 """
 
 from __future__ import annotations
 
+import atexit
+import json
+import os
 import threading
 import time
 from collections import deque
@@ -29,7 +48,16 @@ from deeplearning4j_tpu.obs import metrics
 
 __all__ = ["SpanTracer", "compile_span", "tracer"]
 
-_RING = 512  # finished spans retained
+_RING_DEFAULT = 512  # finished spans retained unless DL4J_TPU_SPAN_RING
+
+
+def _ring_capacity() -> int:
+    raw = os.environ.get("DL4J_TPU_SPAN_RING", "")
+    try:
+        n = int(raw)
+    except ValueError:
+        return _RING_DEFAULT
+    return n if n > 0 else _RING_DEFAULT
 
 
 class _ActiveSpan:
@@ -77,7 +105,8 @@ _NULL = _NullContext()
 
 
 class SpanTracer:
-    def __init__(self, reg: Optional[metrics.MetricsRegistry] = None):
+    def __init__(self, reg: Optional[metrics.MetricsRegistry] = None,
+                 ring_size: Optional[int] = None):
         self._reg = reg or metrics.registry()
         self._hist = self._reg.histogram(
             "dl4j_span_seconds",
@@ -87,9 +116,18 @@ class SpanTracer:
             "dl4j_span_cpu_seconds",
             "thread CPU time inside instrumented spans (dispatch cost; "
             "wall >> cpu means the host was waiting)", ("span",))
+        self._dropped = self._reg.counter(
+            "dl4j_spans_dropped_total",
+            "finished spans evicted from the bounded span ring "
+            "(raise DL4J_TPU_SPAN_RING if this grows during a window "
+            "you want to trace)")
         self._lock = threading.Lock()
-        self._ring: deque = deque(maxlen=_RING)
+        self._ring: deque = deque(maxlen=ring_size or _ring_capacity())
         self._tls = threading.local()
+        # One (wall-clock, perf_counter) pair sampled back to back: maps the
+        # perf_counter timeline every span uses onto wall-clock so trace
+        # export can align event-log instants (wall-clock ts) with spans.
+        self._anchor = {"wall_s": time.time(), "perf_s": time.perf_counter()}
 
     # -- recording ---------------------------------------------------------
 
@@ -125,18 +163,24 @@ class SpanTracer:
         if stack:
             stack.pop()
         parent = stack[-1].name if stack else None
+        th = threading.current_thread()
         rec = {
             "span": sp.name,
+            "t0_s": sp.t0,
             "wall_s": wall,
             "cpu_s": cpu,
             "parent": parent,
             "depth": len(stack),
+            "tid": th.ident,
+            "thread": th.name,
         }
         if error:
             rec["error"] = True
         if sp.attrs:
             rec["attrs"] = sp.attrs
         with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self._dropped.inc()
             self._ring.append(rec)
         self._hist.observe(wall, span=sp.name)
         self._cpu.observe(cpu, span=sp.name)
@@ -148,6 +192,14 @@ class SpanTracer:
         with self._lock:
             out = list(self._ring)
         return out if n is None else out[-n:]
+
+    def anchor(self) -> Dict[str, float]:
+        """The (wall_s, perf_s) pair mapping the span timeline to wall-clock:
+        ``wall = anchor.wall_s + (t0_s - anchor.perf_s)``."""
+        return dict(self._anchor)
+
+    def capacity(self) -> int:
+        return self._ring.maxlen or 0
 
     def summary(self) -> Dict[str, dict]:
         """Per-span-name {count, wall_sum_s, wall_p50_s, wall_max_s, cpu_sum_s}
@@ -166,6 +218,18 @@ class SpanTracer:
             }
         return out
 
+    def dump(self, path: str) -> int:
+        """Write the ring + anchor as JSON for offline trace export
+        (``python -m deeplearning4j_tpu.obs.trace_export --spans <path>``).
+        Returns the number of spans written."""
+        spans = self.recent()
+        doc = {"anchor": self.anchor(), "spans": spans}
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return len(spans)
+
     def clear(self):
         with self._lock:
             self._ring.clear()
@@ -176,6 +240,19 @@ _TRACER = SpanTracer()
 
 def tracer() -> SpanTracer:
     return _TRACER
+
+
+def _dump_at_exit():
+    path = os.environ.get("DL4J_TPU_SPAN_DUMP")
+    if not path:
+        return
+    try:
+        _TRACER.dump(path)
+    except OSError:
+        pass  # exit-time best effort; never mask the real exit status
+
+
+atexit.register(_dump_at_exit)
 
 
 def compile_span(site: str, **attrs):

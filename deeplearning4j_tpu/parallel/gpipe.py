@@ -465,8 +465,13 @@ class GPipeTrainer:
                         return lax.pcast(x, axis_name, to="varying")
                     except ValueError:  # already varying over the pipe axis
                         return x
-                    except (AttributeError, TypeError):  # older jax
-                        return lax.pvary(x, axis_name)
+                    except (AttributeError, TypeError):
+                        pass
+                    try:
+                        return lax.pvary(x, axis_name)  # jax ~0.5/0.6
+                    except AttributeError:
+                        # jax 0.4.x: no varying-axis aval types to cast
+                        return x
 
                 # Each branch is rematerialized (jax.checkpoint): classic
                 # GPipe per-stage activation recomputation, AND it makes
@@ -509,8 +514,21 @@ class GPipeTrainer:
         # outputs carry no vma) therefore cannot run inside stages: the
         # fused-LSTM dispatch is suppressed at trace time (see
         # no_fused_lstm in fit_batch / nn/layers/recurrent.py).
-        return shard_map(fn, mesh=self.mesh, in_specs=in_specs,
-                         out_specs=out_specs)(*args)
+        try:
+            return shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                             out_specs=out_specs)(*args)
+        except Exception as e:  # noqa: BLE001 — jax raises bare Exception here
+            # jax 0.4.x has no pvary, so the lax.switch branches cannot be
+            # unified under its replication checker ("mismatched replication
+            # types"). The check is static-only; disabling it keeps the
+            # psum/ppermute ring semantics intact on 0.4.x.
+            if "replication" not in str(e) and "check_rep" not in str(e):
+                raise
+            try:
+                return shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=False)(*args)
+            except TypeError:
+                raise e
 
     def _loss(self, params, x_micro, y_micro, rng, masks_all=None,
               head_mask=None):

@@ -174,13 +174,14 @@ class GradExchange:
         if e.compress:
             # residual + encode run in f32 regardless of the param dtype so
             # sub-threshold error feedback never rounds away in bf16
-            gflat32 = _pad_flat(_flat(g).astype(jnp.float32), e.n_pad)
-            packed, r = compression.encode_packed(
-                gflat32, r_loc.reshape(-1), thr)
-            gathered = lax.all_gather(packed, self.axis)       # [R, nbytes]
-            g_mean_full = compression.decode_gathered(
-                gathered, e.n_pad, thr, jnp.float32) / R
-            r_new = r[None]                                    # local [1, n_pad]
+            with obs.span("phase.compress", mode="trace"):
+                gflat32 = _pad_flat(_flat(g).astype(jnp.float32), e.n_pad)
+                packed, r = compression.encode_packed(
+                    gflat32, r_loc.reshape(-1), thr)
+                gathered = lax.all_gather(packed, self.axis)   # [R, nbytes]
+                g_mean_full = compression.decode_gathered(
+                    gathered, e.n_pad, thr, jnp.float32) / R
+                r_new = r[None]                                # local [1, n_pad]
         if e.mode == "sharded":
             idx = lax.axis_index(self.axis)
             if e.compress:
@@ -212,6 +213,13 @@ class GradExchange:
         """Replaces the step body's per-layer update loop. Returns
         ``(new_params, new_opt, new_residuals)`` in the model's container
         type (tuple of layers / dict of vertices)."""
+        # trace-time span: this whole method runs inside the shard_map trace,
+        # so a runtime span here would time tracing, not the collectives —
+        # mode="trace" records exactly that (compile-cost attribution)
+        with obs.span("phase.exchange", mode="trace"):
+            return self._update_traced(grads, params, opt_state, residuals, it)
+
+    def _update_traced(self, grads, params, opt_state, residuals, it):
         new_p: Dict[Any, Any] = {}
         new_o: Dict[Any, Any] = {}
         new_r: Dict[Any, Any] = {}

@@ -14,8 +14,11 @@ import queue
 import threading
 from typing import List, Optional
 
+import time
+
 import numpy as np
 
+from .. import obs
 from ..utils import bucketing
 
 
@@ -74,6 +77,17 @@ class ParallelInference:
 
     # -- public ------------------------------------------------------------
     def output(self, x) -> np.ndarray:
+        t0 = time.perf_counter()
+        try:
+            out = self._output(x)
+        except Exception:
+            obs.observe_request("pi.output", time.perf_counter() - t0,
+                                status="error", error=True)
+            raise
+        obs.observe_request("pi.output", time.perf_counter() - t0)
+        return out
+
+    def _output(self, x) -> np.ndarray:
         x = np.asarray(x)
         if self.mode != "batched" or self._thread is None:
             if self._stop.is_set():
@@ -86,6 +100,10 @@ class ParallelInference:
             if self._stop.is_set():
                 raise RuntimeError("ParallelInference is shut down")
             self._queue.put(p)
+            if obs.enabled():
+                obs.gauge("dl4j_inference_queue_depth",
+                          "Requests waiting in the batching queue"
+                          ).set(self._queue.qsize())
         p.event.wait()
         if isinstance(p.result, Exception):
             raise p.result
@@ -144,6 +162,10 @@ class ParallelInference:
             batch = self._drain()
             if not batch:
                 continue
+            if obs.enabled():
+                obs.gauge("dl4j_inference_in_flight",
+                          "Coalesced requests currently on device"
+                          ).set(len(batch))
             try:
                 sizes = [len(p.x) for p in batch]
                 xs = np.concatenate([p.x for p in batch], axis=0)
@@ -164,3 +186,7 @@ class ParallelInference:
                 for p in batch:
                     p.result = e
                     p.event.set()
+            finally:
+                if obs.enabled():
+                    obs.gauge("dl4j_inference_in_flight",
+                              "Coalesced requests currently on device").set(0)

@@ -81,8 +81,14 @@ def _gpipe_shard(params_local, x_micro, *, stage_apply, axis_name, n_stages,
             return lax.pcast(x, axis_name, to="varying")
         except ValueError:  # already varying
             return x
-        except (AttributeError, TypeError):  # older jax
-            return lax.pvary(x, axis_name)
+        except (AttributeError, TypeError):
+            pass
+        try:
+            return lax.pvary(x, axis_name)  # jax ~0.5/0.6 spelling
+        except AttributeError:
+            # jax 0.4.x: avals carry no varying-axis type, so there is
+            # nothing to cast — the carry is usable as-is
+            return x
 
     buf = _pvary(jnp.zeros_like(x_micro[0]))
     outs = _pvary(jnp.zeros_like(x_micro))

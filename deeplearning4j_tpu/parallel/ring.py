@@ -189,7 +189,12 @@ def ring_self_attention(
             # shard_map varying-axes check for this (correct) spec
             return shard_map(fn_flash, mesh=mesh, in_specs=in_specs,
                              out_specs=spec, check_vma=False)(*args)
-        except TypeError:  # older jax: parameter named check_rep / absent
+        except TypeError:
+            pass
+        try:  # jax 0.4/0.5 spell the same knob check_rep
+            return shard_map(fn_flash, mesh=mesh, in_specs=in_specs,
+                             out_specs=spec, check_rep=False)(*args)
+        except TypeError:  # neither parameter exists
             return shard_map(fn_flash, mesh=mesh, in_specs=in_specs,
                              out_specs=spec)(*args)
     fn = functools.partial(_ring_attention_shard, axis_name=seq_axis, causal=causal)

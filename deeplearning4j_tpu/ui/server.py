@@ -144,6 +144,19 @@ class UIServer:
         # /tsne embedding page (reference deeplearning4j-play
         # module/tsne/TsneModule.java): named 2-D point sets + labels
         self._tsne_sets: dict = {}
+        # serving SLOs: requests currently inside a handler (all routes)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+
+    def _note_inflight(self, delta: int) -> None:
+        from deeplearning4j_tpu import obs
+
+        with self._inflight_lock:
+            self._inflight += delta
+            v = self._inflight
+        if obs.enabled():
+            obs.gauge("dl4j_http_in_flight",
+                      "HTTP requests currently being served").set(v)
 
     @classmethod
     def get_instance(cls) -> "UIServer":
@@ -341,7 +354,35 @@ class UIServer:
             def log_message(self, *a):
                 pass
 
+            def _observed(self, handler):
+                """Serving-SLO envelope around every request: in-flight
+                gauge, per-route latency histogram, burn rate (obs/slo.py).
+                ``handler`` returns the response status it sent."""
+                import time as _time
+
+                from urllib.parse import urlparse
+
+                from deeplearning4j_tpu import obs
+
+                route = urlparse(self.path).path
+                outer._note_inflight(1)
+                t0 = _time.perf_counter()
+                status = 500
+                try:
+                    status = handler()
+                finally:
+                    outer._note_inflight(-1)
+                    obs.observe_request(
+                        route, _time.perf_counter() - t0,
+                        status=str(status), error=status >= 500)
+
             def do_GET(self):
+                self._observed(self._handle_get)
+
+            def do_POST(self):
+                self._observed(self._handle_post)
+
+            def _handle_get(self) -> int:
                 from urllib.parse import parse_qs, urlparse
 
                 parsed = urlparse(self.path)
@@ -373,17 +414,26 @@ class UIServer:
 
                     body = obs.prometheus_text().encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif route == "/debug/trace":
+                    # live Chrome/Perfetto trace of the span ring + event
+                    # log (load in ui.perfetto.dev / chrome://tracing)
+                    from deeplearning4j_tpu.obs import trace_export
+
+                    body = trace_export.live_trace(
+                        include_events=True).encode()
+                    ctype = "application/json"
                 else:
                     self.send_response(404)
                     self.end_headers()
-                    return
+                    return 404
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+                return 200
 
-            def do_POST(self):
+            def _handle_post(self) -> int:
                 from urllib.parse import urlparse
 
                 if urlparse(self.path).path == "/tsne":
@@ -399,17 +449,17 @@ class UIServer:
                         self.send_response(400)
                         self.end_headers()
                         self.wfile.write(str(e).encode())
-                        return
+                        return 400
                     self.send_response(200)
                     self.send_header("Content-Length", "2")
                     self.end_headers()
                     self.wfile.write(b"ok")
-                    return
+                    return 200
                 if urlparse(self.path).path != "/remote" \
                         or outer._remote_storage is None:
                     self.send_response(404)
                     self.end_headers()
-                    return
+                    return 404
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     payload = json.loads(self.rfile.read(n).decode("utf-8"))
@@ -434,7 +484,7 @@ class UIServer:
                     self.send_response(400)
                     self.end_headers()
                     self.wfile.write(str(e).encode())
-                    return
+                    return 400
                 try:
                     for kind, rec in staged:
                         if kind == "static":
@@ -445,11 +495,12 @@ class UIServer:
                     self.send_response(500)
                     self.end_headers()
                     self.wfile.write(str(e).encode())
-                    return
+                    return 500
                 self.send_response(200)
                 self.send_header("Content-Length", "2")
                 self.end_headers()
                 self.wfile.write(b"ok")
+                return 200
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self.port = self._httpd.server_address[1]

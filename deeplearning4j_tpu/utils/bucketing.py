@@ -208,6 +208,11 @@ class BucketTelemetry:
             self.trace_shapes.setdefault(site, set()).add(tuple(shape))
         self._compiles.inc(site=site)
         count = self._traces.inc(site=site)
+        # flag the site for lazy cost harvest (obs/profile.py): a set add,
+        # no jax — runs inside the traced body exactly once per compile
+        from deeplearning4j_tpu.obs import profile
+
+        profile.note_trace(site, shape)
         if self._emit_events:
             from deeplearning4j_tpu import obs
 

@@ -1,0 +1,51 @@
+"""graftlint fixture: cost-analysis-off-hot-path true positives —
+HLO cost walks and trace export reachable from traced / per-batch code."""
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.obs import trace_export
+
+
+def fwd(params, x):
+    return jnp.dot(x, params)
+
+
+_jit_fwd = jax.jit(fwd)
+
+
+def step(params, x):
+    out = _jit_fwd(params, x)
+    lowered = jax.jit(fwd).lower(params, x)
+    costs = lowered.cost_analysis()         # BAD: HLO walk per dispatch
+    return out, costs
+
+
+def step_mem(compiled, params, x):
+    out = _jit_fwd(params, x)
+    stats = compiled.memory_analysis()      # BAD: HLO walk per dispatch
+    return out, stats
+
+
+def step_traced(params, x):
+    def body(p, xx):
+        trace_export.live_trace()           # BAD: export inside traced body
+        return jnp.dot(xx, p)
+
+    return jax.jit(body)(params, x)
+
+
+def step_suppressed(compiled, params, x):
+    out = _jit_fwd(params, x)
+    stats = compiled.memory_analysis()  # graftlint: disable=cost-analysis-off-hot-path
+    return out, stats
+
+
+def step_ok(params, x):
+    out = _jit_fwd(params, x)
+    stats = params_cost_table(params)       # fine: plain dict lookup
+    return out, stats
+
+
+def params_cost_table(params):
+    return {"n": len(params)}

@@ -94,6 +94,16 @@ class TestRuleTruePositives:
         assert not _hits(fs, "monotonic-clock", "clock_bad.py",
                          "monotonic_ok")
 
+    def test_cost_analysis_off_hot_path(self, fixture_findings):
+        fs = fixture_findings
+        rule = "cost-analysis-off-hot-path"
+        assert _hits(fs, rule, "cost_analysis_bad.py", "step")
+        assert _hits(fs, rule, "cost_analysis_bad.py", "step_mem")
+        # trace export inside a traced body
+        assert _hits(fs, rule, "cost_analysis_bad.py", "step_traced.body")
+        # plain dict lookups on the dispatch path stay allowed
+        assert not _hits(fs, rule, "cost_analysis_bad.py", "step_ok")
+
     def test_inline_suppressions(self, fixture_findings):
         fs = fixture_findings
         for rule, filename, func in (
@@ -103,6 +113,8 @@ class TestRuleTruePositives:
             ("numpy-on-tracer", "tracer_np_bad.py", "suppressed"),
             ("lock-discipline", "locks_bad.py", "put_suppressed"),
             ("monotonic-clock", "clock_bad.py", "suppressed"),
+            ("cost-analysis-off-hot-path", "cost_analysis_bad.py",
+             "step_suppressed"),
         ):
             assert not _hits(fs, rule, filename, func), (rule, func)
 

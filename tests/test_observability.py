@@ -295,7 +295,9 @@ class TestSnapshotRoundTrip:
             pass
         obs.event("snap_check")
         snap = obs.snapshot()
-        assert set(snap) == {"metrics", "spans", "events", "bucketing"}
+        assert set(snap) == {"metrics", "spans", "events", "bucketing",
+                             "profile"}
+        assert set(snap["profile"]) == {"roofline", "sites", "utilization"}
         assert snap["bucketing"]["real_examples"] == 30
         assert snap["events"]["snap_check"] == 1
         assert snap["spans"]["unit"]["count"] == 1
@@ -315,7 +317,8 @@ class TestSnapshotRoundTrip:
 
         tel = S.read_snapshot(path)["train_state"]["telemetry"]
         # the telemetry field IS an obs.snapshot(), intact through the zip
-        assert set(tel) == {"metrics", "spans", "events", "bucketing"}
+        assert set(tel) == {"metrics", "spans", "events", "bucketing",
+                            "profile"}
         assert "mln.fit_batch" in tel["spans"]
         assert tel["bucketing"]["traces"].get("mln.step") == 1
 
@@ -327,6 +330,276 @@ class TestSnapshotRoundTrip:
         assert reg_snap["dl4j_checkpoint_restore_seconds"][""]["count"] == 1
         assert obs.snapshot()["events"]["checkpoint_saved"] == 1
         assert obs.snapshot()["events"]["checkpoint_restored"] == 1
+
+
+# ---------------------------------------------------------------------------
+# profiling: XLA cost models + roofline utilization (obs/profile.py)
+# ---------------------------------------------------------------------------
+
+
+class TestCostModels:
+    def test_lazy_cost_round_trip_per_step(self, monkeypatch):
+        # per-step AotFunction dispatch: the compile flags the site, the
+        # dispatch captures an exemplar, report time prices it
+        monkeypatch.setenv("DL4J_TPU_CHAIN_STEPS", "0")
+        x, y = _toy_data()
+        model = MultiLayerNetwork(_mlp_conf()).init()
+        model.fit((x, y), epochs=1)
+        rep = obs.cost_report()
+        assert "mln.step" in rep["sites"]
+        entry = next(iter(rep["sites"]["mln.step"].values()))
+        assert entry["source"] == "lazy"
+        assert entry["flops"] > 0
+        assert entry["bytes_accessed"] > 0
+        # the gauges follow the ledger (snapshot keys join labels with |)
+        flops = obs.snapshot()["metrics"]["dl4j_xla_flops"]
+        assert any("site=mln.step" in k for k in flops)
+
+    def test_chain_site_priced_separately(self, monkeypatch):
+        # chained dispatch bypasses AotFunction; the chain executable is
+        # harvested under its own site (K steps per dispatch)
+        monkeypatch.setenv("DL4J_TPU_CHAIN_STEPS", "2")
+        x, y = _toy_data(64)
+        model = MultiLayerNetwork(_mlp_conf()).init()
+        model.fit((x, y), epochs=1, batch_size=16)
+        rep = obs.cost_report()
+        assert "mln.chain" in rep["sites"]
+        entry = next(iter(rep["sites"]["mln.chain"].values()))
+        assert entry["source"] == "lazy"
+        assert entry["flops"] > 0
+
+    def test_aot_harvest_adds_memory_analysis(self):
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.obs import profile as profile_mod
+
+        a = np.zeros((8, 8), np.float32)
+        compiled = jax.jit(lambda u, v: jnp.dot(u, v)).lower(a, a).compile()
+        entry = profile_mod.harvest_compiled("unit.site", compiled, key="k0")
+        assert entry is not None and entry["source"] == "aot"
+        assert entry["flops"] > 0
+        rep = obs.cost_report(resolve=False)
+        assert rep["sites"]["unit.site"]["k0"]["flops"] == entry["flops"]
+        # CPU backend provides memory_analysis: peak-HBM style fields ride
+        if "argument_bytes" in entry:
+            assert entry["argument_bytes"] > 0
+
+    def test_roofline_env_override_yields_mfu(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_PEAK_FLOPS", "1e12")
+        monkeypatch.setenv("DL4J_TPU_HBM_GBPS", "100")
+        monkeypatch.setenv("DL4J_TPU_CHAIN_STEPS", "0")
+        x, y = _toy_data()
+        model = MultiLayerNetwork(_mlp_conf()).init()
+        model.fit((x, y), epochs=1)
+        rep = obs.cost_report()
+        assert rep["roofline"]["source"] == "env"
+        assert rep["roofline"]["peak_bf16_flops"] == 1e12
+        util = rep["utilization"]["mln.step"]
+        assert util["span"] == "mln.fit_batch"
+        assert 0 < util["mfu"] < 1
+        assert util["membw_util"] > 0
+        mfu = obs.snapshot()["metrics"]["dl4j_mfu"]
+        assert any("site=mln.step" in k for k in mfu)
+
+    def test_cost_report_survives_model_collection(self, monkeypatch):
+        # exemplars weakref their jit: resolving after the model is gone
+        # contributes nothing but must not raise
+        monkeypatch.setenv("DL4J_TPU_CHAIN_STEPS", "0")
+        x, y = _toy_data()
+        model = MultiLayerNetwork(_mlp_conf()).init()
+        model.fit((x, y), epochs=1)
+        obs.cost_report()          # resolves while alive
+        del model
+        rep = obs.cost_report()    # no pending left, ledger intact
+        assert "mln.step" in rep["sites"]
+
+
+# ---------------------------------------------------------------------------
+# phase attribution (DL4J_TPU_PHASE_SPANS=1 split-dispatch profiling mode)
+# ---------------------------------------------------------------------------
+
+
+class TestPhaseSpans:
+    def test_phase_spans_nested_under_fit_batch(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_PHASE_SPANS", "1")
+        x, y = _toy_data()
+        model = MultiLayerNetwork(_mlp_conf()).init()
+        model.fit((x, y), epochs=1)
+        by_name = {}
+        for rec in obs.recent_spans():
+            by_name.setdefault(rec["span"], []).append(rec)
+        for name in ("phase.fwd", "phase.bwd", "phase.update"):
+            assert name in by_name, f"missing {name} span"
+            for rec in by_name[name]:
+                assert rec["parent"] == "mln.fit_batch"
+                assert rec["depth"] == 1
+
+    def test_phase_mode_params_match_fused(self, monkeypatch):
+        import jax
+
+        monkeypatch.setenv("DL4J_TPU_CHAIN_STEPS", "0")
+        x, y = _toy_data()
+        fused = MultiLayerNetwork(_mlp_conf()).init()
+        fused.fit((x, y), epochs=2)
+        monkeypatch.setenv("DL4J_TPU_PHASE_SPANS", "1")
+        split = MultiLayerNetwork(_mlp_conf()).init()
+        split.fit((x, y), epochs=2)
+        for a, b in zip(jax.tree_util.tree_leaves(fused.params),
+                        jax.tree_util.tree_leaves(split.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_phase_mode_disables_auto_chaining(self, monkeypatch):
+        # phase profiling wants per-phase dispatch; the auto K-step chain
+        # would hide it (an explicit CHAIN_STEPS count still wins)
+        monkeypatch.setenv("DL4J_TPU_PHASE_SPANS", "1")
+        model = MultiLayerNetwork(_mlp_conf()).init()
+        assert model._chain_k() == 0
+
+
+# ---------------------------------------------------------------------------
+# Chrome/Perfetto trace export (obs/trace_export.py)
+# ---------------------------------------------------------------------------
+
+
+class TestTraceExport:
+    def test_trace_json_schema_and_nesting(self):
+        from deeplearning4j_tpu.obs import trace_export
+
+        with obs.span("outer"):
+            with obs.span("inner"):
+                pass
+        doc = json.loads(trace_export.live_trace())
+        assert trace_export.validate(doc) == []
+        assert doc["displayTimeUnit"] == "ms"
+        evs = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+        assert {"outer", "inner"} <= set(evs)
+        o, i = evs["outer"], evs["inner"]
+        assert i["args"]["parent"] == "outer"
+        assert o["ts"] <= i["ts"]
+        assert i["ts"] + i["dur"] <= o["ts"] + o["dur"] + 1.0  # 1 us slop
+        meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert meta and all(e["name"] == "thread_name" for e in meta)
+
+    def test_cli_round_trip_validates(self, tmp_path):
+        from deeplearning4j_tpu.obs import trace_export
+
+        with obs.span("cli_span"):
+            pass
+        dump = tmp_path / "spans.json"
+        assert obs.save_spans(str(dump)) >= 1
+        out = tmp_path / "trace.json"
+        rc = trace_export.main(
+            ["--spans", str(dump), "--out", str(out), "--validate"])
+        assert rc == 0
+        doc = json.loads(out.read_text())
+        assert any(e["name"] == "cli_span" for e in doc["traceEvents"])
+
+    def test_event_instants_overlay(self, tmp_path):
+        from deeplearning4j_tpu.obs import trace_export
+
+        obs.configure_event_log(str(tmp_path / "ev.jsonl"))
+        with obs.span("with_marker"):
+            obs.event("marker", k=1)
+        doc = json.loads(trace_export.live_trace(include_events=True))
+        assert trace_export.validate(doc) == []
+        inst = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+        assert any(e["name"] == "marker" for e in inst)
+
+    def test_fit_trace_contains_phase_spans(self, monkeypatch):
+        from deeplearning4j_tpu.obs import trace_export
+
+        monkeypatch.setenv("DL4J_TPU_PHASE_SPANS", "1")
+        x, y = _toy_data()
+        MultiLayerNetwork(_mlp_conf()).init().fit((x, y), epochs=1)
+        doc = json.loads(trace_export.live_trace())
+        assert trace_export.validate(doc) == []
+        names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+        assert {"mln.fit_batch", "phase.fwd",
+                "phase.bwd", "phase.update"} <= names
+
+
+# ---------------------------------------------------------------------------
+# serving SLOs (obs/slo.py) + HTTP observability (ui/server.py)
+# ---------------------------------------------------------------------------
+
+
+class TestServingSlo:
+    def test_latency_counts_and_burn_rate(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_SLO_LATENCY_MS", "100")
+        monkeypatch.setenv("DL4J_TPU_SLO_OBJECTIVE", "0.9")
+        for _ in range(8):
+            obs.observe_request("unit.route", 0.01)
+        obs.observe_request("unit.route", 0.5)                  # slow -> bad
+        obs.observe_request("unit.route", 0.01, status="error", error=True)
+        snap = obs.snapshot()["metrics"]
+        assert snap["dl4j_request_seconds"]["route=unit.route"]["count"] == 10
+        totals = snap["dl4j_requests_total"]
+        assert totals["route=unit.route|status=ok"] == 9
+        assert totals["route=unit.route|status=error"] == 1
+        # 2 bad of 10 against a 10% error budget -> burning at 2x
+        burn = snap["dl4j_slo_burn_rate"]["route=unit.route"]
+        assert burn == pytest.approx(2.0, abs=0.01)
+
+    def test_kill_switch_mutes_requests(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_OBS", "0")
+        obs.observe_request("muted", 0.01)
+        snap = obs.snapshot()["metrics"]
+        assert snap.get("dl4j_requests_total", {}) == {}
+
+
+class TestHttpObservability:
+    def test_debug_trace_route_serves_valid_trace(self):
+        from deeplearning4j_tpu.obs import trace_export
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        with obs.span("pre_http"):
+            pass
+        srv = UIServer().serve(port=0)
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/debug/trace") as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith(
+                    "application/json")
+                doc = json.loads(resp.read().decode())
+            # a second request sees the first one's latency in /metrics
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/metrics") as resp:
+                body = resp.read().decode()
+        finally:
+            srv.stop()
+        assert trace_export.validate(doc) == []
+        assert any(e.get("name") == "pre_http" for e in doc["traceEvents"])
+        assert ('dl4j_requests_total{route="/debug/trace",status="200"} 1'
+                in body)
+        assert 'dl4j_request_seconds' in body
+        assert 'dl4j_http_in_flight' in body
+        assert 'dl4j_slo_burn_rate{route="/debug/trace"}' in body
+
+
+# ---------------------------------------------------------------------------
+# span ring knob (DL4J_TPU_SPAN_RING)
+# ---------------------------------------------------------------------------
+
+
+class TestSpanRing:
+    def test_ring_knob_bounds_retention_and_counts_drops(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_SPAN_RING", "4")
+        reg = MetricsRegistry()
+        tr = SpanTracer(reg)
+        for i in range(10):
+            with tr.span(f"s{i}"):
+                pass
+        assert len(tr.recent()) == 4
+        assert reg.counter("dl4j_spans_dropped_total").value() == 6
+
+    def test_explicit_ring_size_wins(self):
+        tr = SpanTracer(MetricsRegistry(), ring_size=2)
+        for i in range(5):
+            with tr.span(f"s{i}"):
+                pass
+        assert len(tr.recent()) == 2
 
 
 # ---------------------------------------------------------------------------

@@ -66,6 +66,18 @@ def _time_step(jax, jnp, model, x, y, warmup=3, iters=12):
     return dt, compiled
 
 
+def _mfu(site, key, compiled, dt):
+    """Peak lookup + static cost harvest live in obs/profile.py (the single
+    MFU methodology); DL4J_TPU_PEAK_FLOPS overrides unknown backends."""
+    from deeplearning4j_tpu.obs import profile
+
+    entry = profile.harvest_compiled(site, compiled, key=key) or {}
+    peak = profile.peak_flops("bfloat16")
+    if not peak:
+        return float("nan")
+    return entry.get("flops", 0.0) / dt / peak
+
+
 def sweep():
     combos = [(128, 128), (64, 128), (128, 64), (256, 128), (128, 256),
               (256, 256), (64, 64)]
@@ -74,10 +86,7 @@ def sweep():
     _, T, d, _, _, B = cfg
     dt, compiled = _time_step(jax, jnp, model, x, y)
     tps = B * T / dt
-    ca = compiled.cost_analysis()
-    ca = ca[0] if isinstance(ca, list) else ca
-    flops = float(ca.get("flops", 0.0))
-    mfu = flops / dt / 197e12
+    mfu = _mfu("exp.transformer", f"bq{bq}bk{bk}", compiled, dt)
     print(f"RESULT block_q={bq} block_k={bk}: {dt*1000:.1f} ms/step "
           f"{tps:,.0f} tok/s MFU={mfu:.3f}", flush=True)
 
@@ -125,9 +134,7 @@ def remat():
     jax, jnp, model, x, y, cfg = _setup()
     _, T, d, _, _, B = cfg
     dt, compiled = _time_step(jax, jnp, model, x, y)
-    ca = compiled.cost_analysis()
-    ca = ca[0] if isinstance(ca, list) else ca
-    mfu = float(ca.get("flops", 0.0)) / dt / 197e12
+    mfu = _mfu("exp.transformer", "remat", compiled, dt)
     print(f"RESULT remat: {dt*1000:.1f} ms/step {B*T/dt:,.0f} tok/s "
           f"MFU={mfu:.3f}", flush=True)
 
