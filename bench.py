@@ -654,6 +654,160 @@ def bench_serving_mixed():
     }
 
 
+def bench_serving_slo():
+    """Serving-tier SLO bench — the serve/ continuous-batching scheduler
+    under a closed-loop load generator.
+
+    Three phases:
+      ramp      concurrency sweep; each level hammers its own ModelWorker
+                (fresh route -> clean quantiles) with mixed-size requests.
+                Saturation = the level with the highest request rate.
+      headline  p99 latency (ms) AT saturation, from the SLO tracker's
+                dl4j_request_seconds P^2 quantiles — the same series the
+                /metrics endpoint and burn-rate gauge are built on.
+      overload  a deliberately starved worker (queue_limit=2) blasted by
+                4x the saturation concurrency; gates that the scheduler
+                SHEDS (dl4j_shed_total > 0) and the burn-rate gauge reacts
+                rather than letting the queue grow without bound.
+
+    Also gates the AOT contract end-to-end: after registry warm-up the
+    entire load run must add ZERO compiles on the request path."""
+    import threading
+    from deeplearning4j_tpu import obs
+    from deeplearning4j_tpu.nn.input_type import InputType
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.model import (
+        MultiLayerConfiguration, MultiLayerNetwork)
+    from deeplearning4j_tpu.obs import slo
+    from deeplearning4j_tpu.serve import (
+        ModelRegistry, ModelWorker, ServeConfig, ShedError)
+    from deeplearning4j_tpu.utils import bucketing
+
+    n_feat, hidden, classes = 32, 256, 10
+    max_batch = 32
+    levels = [1, 2, 4, 8, 16]
+    window_s = 1.0
+    if SMOKE:
+        hidden, max_batch = 16, 16
+        levels = [1, 4]
+        window_s = 0.25
+
+    conf = MultiLayerConfiguration(
+        layers=(Dense(n_out=hidden, activation="relu"),
+                OutputLayer(n_out=classes, activation="softmax")),
+        input_type=InputType.feed_forward(n_feat),
+        updater={"type": "sgd", "lr": 0.05},
+    )
+    model = MultiLayerNetwork(conf).init()
+    tel = bucketing.telemetry()
+    tel.reset()
+
+    cfg = ServeConfig(max_batch=max_batch, queue_limit=512,
+                      default_deadline_s=1.0)
+    reg = ModelRegistry(cfg)
+    reg.register("slo", model, warm=True)          # import -> AOT warm
+    compiles_warm = tel.compiles("mln.output")
+
+    rs = np.random.RandomState(0)
+    sizes = [1, 2, 3, 5, 8]
+    reqs = [rs.rand(s, n_feat).astype(np.float32) for s in sizes]
+    tracker = slo.slo_tracker()
+
+    def closed_loop(worker, conc, duration, deadline_s):
+        """conc threads, each submit-wait-resubmit until the window ends."""
+        stats = {"ok": 0, "rows": 0, "shed": 0}
+        lock = threading.Lock()
+        stop = time.perf_counter() + duration
+
+        def loop(tid):
+            i = tid
+            while time.perf_counter() < stop:
+                try:
+                    out = worker.submit(reqs[i % len(reqs)],
+                                        deadline_s=deadline_s)
+                    with lock:
+                        stats["ok"] += 1
+                        stats["rows"] += len(out)
+                except ShedError:
+                    with lock:
+                        stats["shed"] += 1
+                i += 1
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=loop, args=(t,), daemon=True)
+                   for t in range(conc)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stats["dt"] = time.perf_counter() - t0
+        return stats
+
+    ramp = []
+    try:
+        for conc in levels:
+            # Per-level worker => per-level route; the shared LatencyModel
+            # keeps admission estimates warm across levels.
+            name = "slo_c%d" % conc
+            worker = reg.register(name, model, warm=False)
+            st = closed_loop(worker, conc, window_s, deadline_s=1.0)
+            hist = tracker._hist.summary(route="serve." + name) or {}
+            ramp.append({
+                "concurrency": conc,
+                "rps": round(st["ok"] / st["dt"], 1),
+                "rows_per_s": round(st["rows"] / st["dt"], 1),
+                "shed": st["shed"],
+                "p50_ms": round(hist.get("p50", 0.0) * 1e3, 3),
+                "p99_ms": round(hist.get("p99", 0.0) * 1e3, 3),
+            })
+            if not _budget_left():
+                break
+
+        sat = max(ramp, key=lambda r: r["rps"])
+
+        # Forced-overload arm: starved queue, 4x saturation concurrency,
+        # tight deadline. MUST shed and MUST move the burn-rate gauge.
+        over_cfg = ServeConfig(max_batch=max(4, max_batch // 4),
+                               queue_limit=2, default_deadline_s=0.05)
+        over = ModelWorker("slo_overload", model, config=over_cfg,
+                           latency=reg.latency)
+        try:
+            ost = closed_loop(over, max(8, 4 * sat["concurrency"]),
+                              window_s, deadline_s=0.05)
+        finally:
+            over.shutdown()
+        over_route = "serve.slo_overload"
+        overload = {
+            "ok": ost["ok"],
+            "shed": ost["shed"],
+            "shed_total": int(tracker._count.value(
+                route=over_route, status="shed") or 0),
+            "burn_rate": tracker.burn_rate(over_route) or 0.0,
+        }
+    finally:
+        reg.shutdown()
+
+    return {
+        "metric": "serving_slo_p99",
+        "value": sat["p99_ms"],
+        "unit": "ms",
+        "saturation_rps": sat["rps"],
+        "saturation_rows_per_s": sat["rows_per_s"],
+        "saturation_concurrency": sat["concurrency"],
+        "p50_ms_at_saturation": sat["p50_ms"],
+        "ramp": ramp,
+        "buckets_used": len(
+            tel.buckets_used("serve.slo_c%d" % sat["concurrency"])),
+        "compiles_warm": compiles_warm,
+        "request_path_compiles": tel.compiles("mln.output") - compiles_warm,
+        "overload": overload,
+        "slo": {"threshold_ms": tracker.threshold_s * 1e3,
+                "objective": tracker.objective},
+        "note": "p99 at saturation from dl4j_request_seconds quantiles; "
+                "overload arm gates shed>0 and burn-rate reaction",
+    }
+
+
 def _cpu_mesh_env(n: int = 8) -> dict:
     """Env forcing an n-device host-platform mesh (must be set before jax
     initializes) — the dp_comms microbench models an R-replica exchange on
@@ -1081,6 +1235,7 @@ _BENCHES = {
     "word2vec": bench_word2vec,
     "transformer": bench_transformer,
     "serving": bench_serving_mixed,
+    "serving_slo": bench_serving_slo,
     "dp_comms": bench_dp_comms,
     "checkpoint": bench_checkpoint,
     "mnist_mlp": bench_mnist_mlp,

@@ -510,6 +510,91 @@ def _rule_lock_discipline(index: Index) -> List[Finding]:
                     scan(child, d)
 
             scan(fi.node, 0)
+    out += _lock_hot_sync_findings(index)
+    return out
+
+
+def _lock_hot_sync_findings(index: Index) -> List[Finding]:
+    """Second lock-discipline sub-check, for the serving scheduler's hot
+    path: NO HOST SYNC (and no jitted dispatch) while holding a lock. A
+    ``with <lock>:`` body that pulls a device value to host — device_get,
+    ``.item()``, float/int/bool coercion, np.asarray, block_until_ready —
+    or dispatches a jitted callable serializes every other thread behind
+    XLA: producers can't even enqueue while the device runs. Admission
+    math on host floats under the lock is fine; the device work must
+    happen with the lock released (serve/scheduler.py's dispatch shape)."""
+    out = []
+    for dotted in sorted(index.modules):
+        sm = index.modules[dotted]
+        if not sm.imports_threading:
+            continue
+        for q in sorted(sm.functions):
+            fi = sm.functions[q]
+            if isinstance(fi.node, ast.Module):
+                continue
+            _, tainted = _device_taint(fi, index, seed_params=False)
+
+            def sync_message(node: ast.AST) -> Optional[str]:
+                if not isinstance(node, ast.Call):
+                    return None
+                d = dotted_name(node.func, sm)
+                if d == "jax.device_get":
+                    return ("jax.device_get under a held lock: every thread "
+                            "queues behind the device→host transfer")
+                if d in ("numpy.asarray", "numpy.array", "numpy.copy") \
+                        and node.args and any(tainted(a) for a in node.args):
+                    return (f"{d.replace('numpy', 'np')} on a device value "
+                            "under a held lock: materialization blocks all "
+                            "other lock holders")
+                f = node.func
+                if isinstance(f, ast.Attribute):
+                    if f.attr == "block_until_ready":
+                        return (".block_until_ready() under a held lock: "
+                                "the lock is held for the whole device "
+                                "execution")
+                    if f.attr == "item" and not node.args \
+                            and tainted(f.value):
+                        return (".item() on a device value under a held "
+                                "lock: synchronous host round-trip while "
+                                "others wait")
+                    if f.attr in index.jit_names:
+                        return ("jitted dispatch under a held lock: XLA "
+                                "execution serializes every other thread "
+                                "on this lock")
+                if isinstance(f, ast.Name):
+                    if f.id in ("float", "int", "bool") and node.args \
+                            and tainted(node.args[0]):
+                        return (f"{f.id}() on a device value under a held "
+                                "lock: blocks until the executable "
+                                "finishes while others wait")
+                    if f.id in index.jit_names \
+                            and f.id in sm.global_names:
+                        return ("jitted dispatch under a held lock: XLA "
+                                "execution serializes every other thread "
+                                "on this lock")
+                return None
+
+            def scan(node: ast.AST, lock_depth: int):
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.ClassDef)):
+                        continue
+                    d = lock_depth
+                    if isinstance(child, (ast.With, ast.AsyncWith)) and any(
+                            _lockish(item.context_expr)
+                            for item in child.items):
+                        d += 1
+                    if lock_depth > 0:
+                        msg = sync_message(child)
+                        if msg:
+                            f = index.make_finding("lock-discipline", fi,
+                                                   child.lineno, msg)
+                            if f:
+                                out.append(f)
+                    scan(child, d)
+
+            scan(fi.node, 0)
     return out
 
 

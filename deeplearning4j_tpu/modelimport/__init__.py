@@ -19,4 +19,21 @@ __all__ = [
     "UnsupportedKerasConfigurationError",
     "import_dl4j_zip",
     "export_dl4j_zip",
+    "import_model",
 ]
+
+
+def import_model(path: str):
+    """Format-detecting loader: Keras HDF5 (``.h5``/``.hdf5``/``.keras``)
+    via :class:`KerasModelImport` (Sequential vs functional auto-detected)
+    or DL4J zip via :func:`import_dl4j_zip`. The serving tier's model
+    registry loads everything through here so one path string is all a
+    deployment manifest needs."""
+    lower = str(path).lower()
+    if lower.endswith((".h5", ".hdf5", ".keras")):
+        return KerasModelImport.import_keras_model(path)
+    if lower.endswith(".zip"):
+        return import_dl4j_zip(path)
+    raise ValueError(
+        f"unrecognized model format: {path!r} (expected .h5/.hdf5/.keras "
+        "for Keras or .zip for DL4J)")

@@ -85,6 +85,7 @@ __all__ = [
     "warm_dp",
     "warm_fit",
     "warm_serving",
+    "warm_serving_bundled",
     "wrap",
 ]
 
@@ -321,6 +322,22 @@ def warm_serving(model, max_batch: int,
               executables=fn.compiled_count,
               duration_s=round(time.perf_counter() - t0, 6))
     return fn.compiled_count
+
+
+def warm_serving_bundled(model, max_batch: int, bundle_path,
+                         ladder: Optional[bucketing.BucketLadder] = None
+                         ) -> Tuple[int, int]:
+    """The serving tier's one-call warm pipeline: restore any executables
+    persisted at ``bundle_path``, ladder-warm the inference path up to
+    ``max_batch`` (restored signatures dispatch instead of recompiling),
+    then persist the now-warm set back (best-effort; both bundle directions
+    are validation-gated by ``persistence_allowed``). Returns
+    ``(restored, warmed)`` executable counts."""
+    restored = restore_bundle(model, bundle_path) if bundle_path else 0
+    warmed = warm_serving(model, max_batch, ladder)
+    if bundle_path and warmed:
+        save_bundle(model, bundle_path)
+    return restored, warmed
 
 
 def _first_fit_batch(model, data, batch_size):

@@ -777,7 +777,8 @@ class ComputationGraph:
     # -- forward -----------------------------------------------------------
     def _forward(self, params, state, inputs: Dict[str, jax.Array], *, train, rngs,
                  masks: Optional[Dict[str, Any]] = None, stop_at: Optional[set] = None,
-                 collect: bool = False, ex_weight=None, carries: Optional[dict] = None):
+                 collect: bool = False, ex_weight=None, carries: Optional[dict] = None,
+                 deterministic: bool = False):
         """Walk topo order. Returns (acts, new_state, mask_acts, new_carries).
 
         ``stop_at``: vertex names whose activation should be the PRE-output
@@ -791,6 +792,9 @@ class ComputationGraph:
         self._carry_vertices — when given, recurrent layer vertices run
         ``apply_seq`` from the supplied carry and the final carries are
         returned (the doTruncatedBPTT / rnnActivateUsingStoredState channel).
+        ``deterministic`` (score(train=True) path): rng-drawing vertices
+        (dropout / weight noise) run in eval mode while normalization keeps
+        batch statistics — same contract as MultiLayerNetwork._forward.
         """
         acts: Dict[str, jax.Array] = dict(inputs)
         mask_acts: Dict[str, Any] = dict(masks or {})
@@ -814,30 +818,32 @@ class ComputationGraph:
                 mask_acts[name] = m
                 new_state[name] = state[name]
                 continue
+            vtrain = train and not (
+                deterministic and getattr(v.config, "uses_rng", lambda: False)())
             if v.spec.is_layer():
                 x, m = xs[0], in_masks[0]
                 it = self.vertex_types[v.inputs[0]] if v.inputs[0] in self.vertex_types \
                     else self.conf.input_types[v.inputs[0]]
                 if v.pre is not None:
-                    x, _ = v.pre.apply({}, {}, x, train=train, rng=None, mask=m)
+                    x, _ = v.pre.apply({}, {}, x, train=vtrain, rng=None, mask=m)
                     m = v.pre.propagate_mask(m, it)
                     it = v.input_types[0]
                 p_v = params[name]
-                if train and v.config.weight_noise and rng is not None:
+                if vtrain and v.config.weight_noise and rng is not None:
                     p_v = v.config.maybe_weight_noise(
-                        p_v, train, jax.random.fold_in(rng, 0x5EED)
+                        p_v, vtrain, jax.random.fold_in(rng, 0x5EED)
                     )
                 if new_carries is not None and name in new_carries:
-                    x2 = v.config.maybe_dropout_input(x, train, rng)
+                    x2 = v.config.maybe_dropout_input(x, vtrain, rng)
                     y, c = v.config.apply_seq(p_v, x2, new_carries[name], m)
                     new_carries[name] = c
                     ns = state[name]
                 elif ex_weight is not None and getattr(v.config, "CONSUMES_EXAMPLE_WEIGHT", False):
-                    y, ns = v.config.apply(p_v, state[name], x, train=train,
+                    y, ns = v.config.apply(p_v, state[name], x, train=vtrain,
                                            rng=rng, mask=m, ex_weight=ex_weight)
                 else:
                     y, ns = v.config.apply(p_v, state[name], x,
-                                           train=train, rng=rng, mask=m)
+                                           train=vtrain, rng=rng, mask=m)
                 mask_acts[name] = v.config.propagate_mask(m, it)
             else:
                 # mask_input: vertex reads the mask of a NAMED input instead
@@ -846,7 +852,7 @@ class ComputationGraph:
                 if ms is not None:
                     in_masks = [mask_acts.get(ms)] + in_masks[1:]
                 y, ns = v.config.apply(params[name], state[name], xs,
-                                       train=train, rng=rng, masks=in_masks)
+                                       train=vtrain, rng=rng, masks=in_masks)
                 mask_acts[name] = v.config.propagate_mask(in_masks, v.input_types)
             acts[name] = y
             new_state[name] = ns
@@ -854,11 +860,11 @@ class ComputationGraph:
 
     # -- loss --------------------------------------------------------------
     def _loss(self, params, state, inputs, labels, fmasks, lmasks, rngs, train=True,
-              ex_weight=None, carries=None):
+              ex_weight=None, carries=None, deterministic=False):
         stop = set(self._loss_vertices)
         acts, new_state, mask_acts, new_carries = self._forward(
             params, state, inputs, train=train, rngs=rngs, masks=fmasks, stop_at=stop,
-            ex_weight=ex_weight, carries=carries,
+            ex_weight=ex_weight, carries=carries, deterministic=deterministic,
         )
         total = jnp.asarray(0.0, jnp.float32)
         for i, oname in enumerate(self.conf.outputs):
@@ -1468,10 +1474,15 @@ class ComputationGraph:
     def rnn_clear_previous_state(self):
         self._rnn_carries = None
 
-    def score(self, batch) -> float:
+    def score(self, batch, train: bool = False) -> float:
+        """Average loss on a batch. ``train=True`` scores with training-mode
+        statistics (BatchNorm uses the batch's own mean/var, not the running
+        estimates) while dropout / weight noise stay disabled — deterministic;
+        see MultiLayerNetwork.score."""
         f, l, fm, lm = self._as_multi_batch(batch)
         loss, _ = self._loss(self.params, self.state, self._input_dict(f), l,
-                             self._mask_dict(fm), lm, rngs=None, train=False)
+                             self._mask_dict(fm), lm, rngs=None, train=train,
+                             deterministic=True)
         return float(loss)
 
     def evaluate(self, data, batch_size: Optional[int] = None, top_n: int = 1):

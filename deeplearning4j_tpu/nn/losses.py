@@ -218,9 +218,14 @@ def per_example_scores(
         if weights is not None:
             elem = elem * jnp.asarray(weights, elem.dtype)
     elif loss_name == "xent" and str(activation).lower() == "sigmoid":
-        # stable BCE with logits: max(z,0) - z*y + log(1+exp(-|z|))
+        # stable BCE with logits: logaddexp(0, z) - z*y == log(1+e^z) - z*y.
+        # NOT the max(z,0)+log1p(exp(-|z|)) spelling: that form is smooth in
+        # value but kinked in expression, so AD at z == 0 exactly (a fully
+        # relu-dead row under a zero-init bias) returns -y instead of the
+        # true sigmoid(0)-y. logaddexp computes the same stable value with
+        # the correct derivative everywhere.
         z = preact
-        elem = jnp.maximum(z, 0.0) - z * labels + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        elem = jnp.logaddexp(0.0, z) - z * labels
         if weights is not None:
             elem = elem * jnp.asarray(weights, elem.dtype)
     else:

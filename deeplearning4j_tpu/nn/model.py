@@ -399,11 +399,17 @@ class MultiLayerNetwork:
 
     # -- forward -----------------------------------------------------------
     def _forward(self, params, state, x, *, train, rngs, fmask=None, carries=None,
-                 upto: Optional[int] = None, collect=False, ex_weight=None):
+                 upto: Optional[int] = None, collect=False, ex_weight=None,
+                 deterministic=False):
         """Walk the layer stack. Returns (act, new_state, new_carries, mask,
         activations_list). ``ex_weight`` is a per-example [B] validity weight
         consumed only by layers that declare CONSUMES_EXAMPLE_WEIGHT
-        (BatchNorm excludes zero-weighted padding rows from batch stats)."""
+        (BatchNorm excludes zero-weighted padding rows from batch stats).
+        ``deterministic`` (score(train=True) path): layers whose train-mode
+        apply draws randomness (dropout / weight noise — ``uses_rng``) run in
+        eval mode while everything else keeps train-mode semantics, so
+        normalization layers still use batch statistics but the result is a
+        pure function of (params, state, x)."""
         n = len(self.layers) if upto is None else upto
         acts_list = []
         new_state = list(state)
@@ -413,20 +419,21 @@ class MultiLayerNetwork:
         for i in range(n):
             layer = self.layers[i]
             lrng = rngs[i] if rngs is not None else None
+            ltrain = train and not (deterministic and layer.uses_rng())
             p_i = params[i]
-            if train and layer.weight_noise and lrng is not None:
+            if ltrain and layer.weight_noise and lrng is not None:
                 # separate stream from input dropout on the same layer
-                p_i = layer.maybe_weight_noise(p_i, train, jax.random.fold_in(lrng, 0x5EED))
+                p_i = layer.maybe_weight_noise(p_i, ltrain, jax.random.fold_in(lrng, 0x5EED))
             if new_carries is not None and self._carry_flags[i]:
-                a2 = layer.maybe_dropout_input(a, train, lrng)
+                a2 = layer.maybe_dropout_input(a, ltrain, lrng)
                 a, c = layer.apply_seq(p_i, a2, new_carries[i], mask)
                 new_carries[i] = c
                 ns = state[i]
             elif ex_weight is not None and getattr(layer, "CONSUMES_EXAMPLE_WEIGHT", False):
-                a, ns = layer.apply(p_i, state[i], a, train=train, rng=lrng,
+                a, ns = layer.apply(p_i, state[i], a, train=ltrain, rng=lrng,
                                     mask=mask, ex_weight=ex_weight)
             else:
-                a, ns = layer.apply(p_i, state[i], a, train=train, rng=lrng, mask=mask)
+                a, ns = layer.apply(p_i, state[i], a, train=ltrain, rng=lrng, mask=mask)
             new_state[i] = ns
             mask = layer.propagate_mask(mask, self.layer_input_types[i])
             if collect:
@@ -451,11 +458,12 @@ class MultiLayerNetwork:
 
     # -- loss --------------------------------------------------------------
     def _loss(self, params, state, x, y, fmask, lmask, rngs, carries=None, train=True,
-              ex_weight=None):
+              ex_weight=None, deterministic=False):
         """Average score incl. L1/L2 penalties; returns (loss, (new_state, carries))."""
         a, new_state, new_carries, prop_mask, _ = self._forward(
             params, state, x, train=train, rngs=rngs, fmask=fmask,
             carries=carries, upto=len(self.layers) - 1, ex_weight=ex_weight,
+            deterministic=deterministic,
         )
         out_layer = self.layers[-1]
         out_mask = lmask if lmask is not None else prop_mask
@@ -984,8 +992,17 @@ class MultiLayerNetwork:
         idx = jnp.argmax(self.output(x), axis=-1)
         return np.asarray(idx)  # graftlint: disable=host-sync
 
-    def score(self, batch_or_x, y=None, fmask=None, lmask=None) -> float:
-        """Average loss on a batch (MultiLayerNetwork.score)."""
+    def score(self, batch_or_x, y=None, fmask=None, lmask=None,
+              train: bool = False) -> float:
+        """Average loss on a batch (MultiLayerNetwork.score(data, training)).
+
+        ``train=True`` scores with training-mode statistics — normalization
+        layers use the batch's own mean/var instead of the (one-step-stale)
+        running estimates — while dropout / weight noise stay disabled, so
+        the result is deterministic. This is the right mode for "did the
+        training loss go down" checks on deep BatchNorm stacks, where eval
+        statistics lag the params by a step and the error compounds through
+        every BN layer."""
         if y is None:
             x, y, fmask, lmask = _as_batch(batch_or_x)
         else:
@@ -996,7 +1013,8 @@ class MultiLayerNetwork:
             jnp.asarray(fmask, self.dtype) if fmask is not None else None,
             jnp.asarray(lmask, self.dtype) if lmask is not None else None,
             rngs=None,
-            train=False,
+            train=train,
+            deterministic=True,
         )
         return float(loss)
 

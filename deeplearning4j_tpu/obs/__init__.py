@@ -46,6 +46,7 @@ __all__ = [
     "gauge",
     "histogram",
     "observe_request",
+    "observe_shed",
     "phase_spans_enabled",
     "prometheus_text",
     "recent_spans",
@@ -145,6 +146,14 @@ def observe_request(route: str, latency_s: float, status: str = "ok",
     from deeplearning4j_tpu.obs import slo as _slo
 
     _slo.observe_request(route, latency_s, status=status, error=error)
+
+
+def observe_shed(route: str, reason: str = "backpressure"):
+    """Record one load-shedding decision against the SLO tracker
+    (see obs/slo.py). No-op when DL4J_TPU_OBS=0; never raises."""
+    from deeplearning4j_tpu.obs import slo as _slo
+
+    _slo.observe_shed(route, reason=reason)
 
 
 # -- events -----------------------------------------------------------------

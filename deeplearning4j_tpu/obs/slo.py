@@ -12,8 +12,13 @@ per route:
 - ``dl4j_slo_burn_rate{route}``       — how fast the route is spending its
   error budget over a sliding window: ``bad_fraction / (1 - objective)``.
   1.0 = burning budget exactly as fast as the objective allows; >1 = paging
-  territory; 0 = clean window. A request is *bad* when it errors or its
-  latency exceeds the threshold.
+  territory; 0 = clean window. A request is *bad* when it errors, its
+  latency exceeds the threshold, or it was SHED by the serving tier;
+- ``dl4j_shed_total{route,reason}``   — load-shedding decisions by reason
+  (``backpressure`` → HTTP 429, ``deadline`` → HTTP 503; ``serve/``).
+  Shed requests also count into ``dl4j_requests_total{status="shed"}`` and
+  into the burn-rate window, so overload moves the same gauge paging
+  watches for latency SLO violations.
 
 Knobs (read at tracker construction): ``DL4J_TPU_SLO_LATENCY_MS`` (latency
 threshold, default 250), ``DL4J_TPU_SLO_OBJECTIVE`` (good-request
@@ -39,7 +44,7 @@ from typing import Deque, Dict, Optional, Tuple
 
 from deeplearning4j_tpu.obs import metrics
 
-__all__ = ["SloTracker", "slo_tracker", "observe_request"]
+__all__ = ["SloTracker", "slo_tracker", "observe_request", "observe_shed"]
 
 
 class SloTracker:
@@ -71,6 +76,10 @@ class SloTracker:
             "error-budget burn rate over the sliding window: bad_fraction / "
             "(1 - objective); 1.0 = spending budget exactly at the "
             "objective rate", ("route",))
+        self._shed = self._reg.counter(
+            "dl4j_shed_total",
+            "load-shedding decisions by route and reason (backpressure -> "
+            "429, deadline -> 503)", ("route", "reason"))
         self._lock = threading.Lock()
         # route -> deque[(perf_counter_ts, is_bad)]
         self._windows: Dict[str, Deque[Tuple[float, bool]]] = {}
@@ -82,21 +91,37 @@ class SloTracker:
         try:
             self._hist.observe(latency_s, route=route)
             self._count.inc(route=route, status=status)
-            bad = error or latency_s > self.threshold_s
-            now = time.perf_counter()
-            horizon = now - self.window_s
-            with self._lock:
-                win = self._windows.get(route)
-                if win is None:
-                    win = self._windows[route] = deque()
-                win.append((now, bad))
-                while win and win[0][0] < horizon:
-                    win.popleft()
-                n_bad = sum(1 for _, b in win if b)
-                rate = (n_bad / len(win)) / (1.0 - self.objective)
-            self._burn.set(round(rate, 4), route=route)
+            self._note_window(route, error or latency_s > self.threshold_s)
         except Exception:
             pass
+
+    def observe_shed(self, route: str, reason: str = "backpressure"):
+        """Record one load-shedding decision (``serve/`` scheduler). A shed
+        counts as a BAD request for the burn rate — rejecting traffic spends
+        error budget, which is exactly what makes the overload visible on
+        the same gauge paging watches for latency violations — but it does
+        not enter the latency histogram (a shed has no service latency).
+        Never raises."""
+        try:
+            self._count.inc(route=route, status="shed")
+            self._shed.inc(route=route, reason=reason)
+            self._note_window(route, True)
+        except Exception:
+            pass
+
+    def _note_window(self, route: str, bad: bool):
+        now = time.perf_counter()
+        horizon = now - self.window_s
+        with self._lock:
+            win = self._windows.get(route)
+            if win is None:
+                win = self._windows[route] = deque()
+            win.append((now, bad))
+            while win and win[0][0] < horizon:
+                win.popleft()
+            n_bad = sum(1 for _, b in win if b)
+            rate = (n_bad / len(win)) / (1.0 - self.objective)
+        self._burn.set(round(rate, 4), route=route)
 
     def burn_rate(self, route: str) -> Optional[float]:
         return self._burn.value(route=route)
@@ -128,6 +153,14 @@ def observe_request(route: str, latency_s: float, status: str = "ok",
 
     if obs.enabled():
         slo_tracker().observe(route, latency_s, status=status, error=error)
+
+
+def observe_shed(route: str, reason: str = "backpressure"):
+    """Module-level convenience; honors the DL4J_TPU_OBS kill switch."""
+    from deeplearning4j_tpu import obs
+
+    if obs.enabled():
+        slo_tracker().observe_shed(route, reason=reason)
 
 
 def _reset_tracker():

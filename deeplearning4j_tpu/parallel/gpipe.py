@@ -637,13 +637,7 @@ class GPipeTrainer:
                 head = apply_constraints(self.head_cfg, head)
             return stacked, head
 
-        def step(params, opt_state, bn_state, it, x_micro, y_micro, rng,
-                 masks_all=None, head_mask=None):
-            (loss, aux), grads = jax.value_and_grad(
-                self._loss, has_aux=True)(params, x_micro, y_micro, rng,
-                                          masks_all, head_mask)
-            if has_gn:
-                grads = norm_grads(grads)
+        def apply_update(params, opt_state, bn_state, it, loss, aux, grads):
             upd, new_opt = updater.update(grads, opt_state, params, it)
             su, hu = upd
             # per-position lr scale (per-layer overrides / frozen layers);
@@ -659,7 +653,46 @@ class GPipeTrainer:
             new_bn = self._chain_bn_states(bn_state, aux)
             return new_params, new_opt, new_bn, loss
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        def step(params, opt_state, bn_state, it, x_micro, y_micro, rng,
+                 masks_all=None, head_mask=None):
+            (loss, aux), grads = jax.value_and_grad(
+                self._loss, has_aux=True)(params, x_micro, y_micro, rng,
+                                          masks_all, head_mask)
+            return apply_update(params, opt_state, bn_state, it, loss, aux,
+                                grads)
+
+        if not has_gn:
+            return jax.jit(step, donate_argnums=(0, 1, 2))
+
+        # Gradient normalization must NOT run inside a jitted executable
+        # that also sees the pipe-sharded state: the GSPMD partitioner
+        # resolves the nonlinear clip/renorm intermediate inconsistently
+        # between its consumers — the norm is taken over the per-replica
+        # value while the downstream subtraction consumes a spuriously
+        # all-reduced copy, scaling the applied update by exactly the
+        # data*seq replica count (observed 4x on a data=2 x seq=2 mesh).
+        # Sharding constraints, optimization barriers, and materializing
+        # the gradients at a jit boundary all fail to stop it; only fully
+        # replicated operands compile correctly, which would defeat the
+        # pipe-sharded parameter layout. So the clip math runs EAGERLY on
+        # the [S, Lmax] stage vectors between the two executables — a few
+        # tiny elementwise/norm dispatches per step, only for gn-bearing
+        # configs — and the (linear-in-grads) updater half stays jitted.
+        grads_jit = jax.jit(
+            lambda params, x_micro, y_micro, rng, masks_all=None,
+            head_mask=None: jax.value_and_grad(self._loss, has_aux=True)(
+                params, x_micro, y_micro, rng, masks_all, head_mask))
+        update_jit = jax.jit(apply_update, donate_argnums=(0, 1, 2))
+
+        def split_step(params, opt_state, bn_state, it, x_micro, y_micro,
+                       rng, masks_all=None, head_mask=None):
+            (loss, aux), grads = grads_jit(params, x_micro, y_micro, rng,
+                                           masks_all, head_mask)
+            grads = norm_grads(grads)  # eager: see partitioner note above
+            return update_jit(params, opt_state, bn_state, it, loss, aux,
+                              grads)
+
+        return split_step
 
     # -- training API ------------------------------------------------------
     def fit_batch(self, x, y, fm=None, lm=None):

@@ -23,12 +23,13 @@ from ..utils import bucketing
 
 
 class _Pending:
-    __slots__ = ("x", "event", "result")
+    __slots__ = ("x", "event", "result", "deadline")
 
-    def __init__(self, x):
+    def __init__(self, x, deadline: Optional[float] = None):
         self.x = x
         self.event = threading.Event()
         self.result = None
+        self.deadline = deadline  # perf_counter scale, None = no deadline
 
 
 class ParallelInference:
@@ -76,10 +77,19 @@ class ParallelInference:
             self._thread.start()
 
     # -- public ------------------------------------------------------------
-    def output(self, x) -> np.ndarray:
+    def output(self, x, deadline_ms: Optional[float] = None) -> np.ndarray:
+        """``deadline_ms`` (relative to now): a batched request still queued
+        when its deadline passes is SHED — it fails fast with
+        :class:`~deeplearning4j_tpu.serve.scheduler.ShedError` instead of
+        returning a late answer, and counts into ``dl4j_shed_total`` /
+        the SLO burn window (serve-tier semantics; docs/SERVING.md)."""
+        from ..serve.scheduler import ShedError
+
         t0 = time.perf_counter()
         try:
-            out = self._output(x)
+            out = self._output(x, deadline_ms=deadline_ms)
+        except ShedError:
+            raise  # already accounted via observe_shed, not a latency sample
         except Exception:
             obs.observe_request("pi.output", time.perf_counter() - t0,
                                 status="error", error=True)
@@ -87,13 +97,15 @@ class ParallelInference:
         obs.observe_request("pi.output", time.perf_counter() - t0)
         return out
 
-    def _output(self, x) -> np.ndarray:
+    def _output(self, x, deadline_ms: Optional[float] = None) -> np.ndarray:
         x = np.asarray(x)
         if self.mode != "batched" or self._thread is None:
             if self._stop.is_set():
                 raise RuntimeError("ParallelInference is shut down")
             return np.asarray(self.model.output(x))
-        p = _Pending(x)
+        deadline = (None if deadline_ms is None
+                    else time.perf_counter() + float(deadline_ms) / 1e3)
+        p = _Pending(x, deadline=deadline)
         # enqueue under the shutdown lock so a request can't slip into the
         # queue after shutdown() drained it (check-then-put race)
         with self._lifecycle_lock:
@@ -155,7 +167,22 @@ class ParallelInference:
             batch.append(p)
             if p.x is not None:
                 n += len(p.x)
-        return [p for p in batch if p.x is not None]
+        return self._shed_expired([p for p in batch if p.x is not None])
+
+    def _shed_expired(self, batch: List[_Pending]) -> List[_Pending]:
+        """Fail queued requests whose deadline already passed instead of
+        spending device time on answers nobody is waiting for."""
+        live = [p for p in batch if p.deadline is None
+                or p.deadline >= time.perf_counter()]
+        for p in batch:
+            if p not in live:
+                from ..serve.scheduler import ShedError
+
+                obs.observe_shed("pi.output", reason="deadline")
+                p.result = ShedError(
+                    "deadline", "deadline expired in the batching queue")
+                p.event.set()
+        return live
 
     def _worker_loop(self):
         while not self._stop.is_set():

@@ -1,0 +1,42 @@
+"""serve/ — continuous-batching inference tier.
+
+The serving counterpart to the training runtime: an HTTP/JSON front
+(serve/server.py) over per-model continuous-batching pools
+(serve/scheduler.py) whose coalescing decisions are deadline admission
+math over measured per-bucket latency (serve/admission.py), fed by the
+import → AOT-warm → serve registry pipeline (serve/registry.py). Shared
+HTTP plumbing (SLO envelope, /metrics, /healthz) lives in
+serve/httpcommon.py and is reused by ui/server.py.
+
+Quick start::
+
+    from deeplearning4j_tpu import serve
+
+    registry = serve.ModelRegistry()
+    registry.load("mnist", "model.h5")          # import + AOT warm
+    srv = serve.InferenceServer(registry).start(port=8000)
+    # POST /v1/models/mnist:predict {"inputs": [...], "deadline_ms": 50}
+
+or from a shell: ``python -m deeplearning4j_tpu.serve mnist=model.h5``.
+
+Knobs: ``DL4J_TPU_SERVE_MAX_BATCH``, ``DL4J_TPU_SERVE_QUEUE``,
+``DL4J_TPU_SERVE_MARGIN_MS``, ``DL4J_TPU_SERVE_WAIT_MS``,
+``DL4J_TPU_SERVE_WAIT_QUANTUM_MS``, ``DL4J_TPU_SERVE_DEFAULT_DEADLINE_MS``,
+``DL4J_TPU_SERVE_MIN_SAMPLES``, ``DL4J_TPU_SERVE_WORKERS`` — docs/SERVING.md.
+"""
+
+from deeplearning4j_tpu.serve.admission import (
+    AdmissionController, LatencyModel, ServeConfig)
+from deeplearning4j_tpu.serve.registry import ModelRegistry
+from deeplearning4j_tpu.serve.scheduler import ModelWorker, ShedError
+from deeplearning4j_tpu.serve.server import InferenceServer
+
+__all__ = [
+    "AdmissionController",
+    "InferenceServer",
+    "LatencyModel",
+    "ModelRegistry",
+    "ModelWorker",
+    "ServeConfig",
+    "ShedError",
+]

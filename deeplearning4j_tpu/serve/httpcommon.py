@@ -1,0 +1,147 @@
+"""Shared HTTP plumbing for the repo's two stdlib servers.
+
+``ui/server.py`` (dashboard) and ``serve/server.py`` (inference) carry the
+same non-negotiables on every route: the serving-SLO envelope (per-route
+latency histogram + ``dl4j_requests_total{route,status}`` + burn rate via
+obs/slo.py), the ``dl4j_http_in_flight`` gauge, quiet request logging, and
+the operational endpoints ``/metrics`` (Prometheus text exposition) and
+``/healthz``. This module owns those once:
+
+- :class:`InFlight` — the shared in-flight counter → gauge;
+- :class:`ObservedHandler` — a BaseHTTPRequestHandler that wraps
+  ``handle_get``/``handle_post`` (return the status they sent) in the SLO
+  envelope and answers ``/metrics`` + ``/healthz`` before delegating;
+- :func:`start_server` — ThreadingHTTPServer on 127.0.0.1 + daemon thread.
+
+Subclasses override ``handle_get``/``handle_post`` and reply through
+``send_body``/``send_json``/``send_error_body`` so Content-Length is always
+right.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib.parse import urlparse
+
+from deeplearning4j_tpu import obs
+
+__all__ = ["InFlight", "ObservedHandler", "start_server"]
+
+PROM_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class InFlight:
+    """Requests currently inside a handler, mirrored to the
+    ``dl4j_http_in_flight`` gauge (shared by every server in the process —
+    the gauge is process-wide saturation, not per-listener)."""
+
+    def __init__(self):
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def note(self, delta: int) -> None:
+        with self._lock:
+            self._n += delta
+            v = self._n
+        if obs.enabled():
+            obs.gauge("dl4j_http_in_flight",
+                      "HTTP requests currently being served").set(v)
+
+
+class ObservedHandler(BaseHTTPRequestHandler):
+    """SLO-observed request handler with the common operational routes.
+
+    Class attribute ``inflight`` (an :class:`InFlight`) is injected by the
+    server that mounts the handler. ``slo_route(path)`` may be overridden
+    to collapse high-cardinality paths (e.g. per-model predict URLs) into a
+    bounded route label set.
+    """
+
+    inflight: Optional[InFlight] = None
+
+    def log_message(self, *a):  # quiet: obs carries the signal
+        pass
+
+    # -- envelope ----------------------------------------------------------
+
+    def slo_route(self, path: str) -> str:
+        return path
+
+    def _observed(self, handler):
+        route = self.slo_route(urlparse(self.path).path)
+        if self.inflight is not None:
+            self.inflight.note(1)
+        t0 = time.perf_counter()
+        status = 500
+        try:
+            status = handler()
+        finally:
+            if self.inflight is not None:
+                self.inflight.note(-1)
+            obs.observe_request(route, time.perf_counter() - t0,
+                                status=str(status), error=status >= 500)
+
+    def do_GET(self):
+        self._observed(self._get_with_common)
+
+    def do_POST(self):
+        self._observed(self.handle_post)
+
+    def _get_with_common(self) -> int:
+        route = urlparse(self.path).path
+        if route == "/metrics":
+            return self.send_body(200, obs.prometheus_text().encode(),
+                                  PROM_CTYPE)
+        if route == "/healthz":
+            return self.send_json(200, {"status": "ok"})
+        return self.handle_get()
+
+    # -- overridables ------------------------------------------------------
+
+    def handle_get(self) -> int:
+        self.send_response(404)
+        self.end_headers()
+        return 404
+
+    def handle_post(self) -> int:
+        self.send_response(404)
+        self.end_headers()
+        return 404
+
+    # -- reply helpers -----------------------------------------------------
+
+    def send_body(self, status: int, body: bytes, ctype: str,
+                  headers: Tuple[Tuple[str, str], ...] = ()) -> int:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+        return status
+
+    def send_json(self, status: int, payload,
+                  headers: Tuple[Tuple[str, str], ...] = ()) -> int:
+        return self.send_body(status, json.dumps(payload).encode(),
+                              "application/json", headers)
+
+    def read_json(self):
+        n = int(self.headers.get("Content-Length", "0"))
+        return json.loads(self.rfile.read(n).decode("utf-8"))
+
+
+def start_server(handler_cls, port: int = 0,
+                 host: str = "127.0.0.1") -> Tuple[ThreadingHTTPServer,
+                                                   threading.Thread, int]:
+    """Bind ``handler_cls`` and serve it from a daemon thread. Returns
+    ``(httpd, thread, bound_port)`` (``port=0`` lets the OS pick)."""
+    httpd = ThreadingHTTPServer((host, port), handler_cls)
+    bound = httpd.server_address[1]
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    return httpd, thread, bound

@@ -1,0 +1,298 @@
+"""Continuous-batching scheduler: per-model queues, coalescing dispatchers.
+
+One :class:`ModelWorker` per served model owns a bounded request queue and
+a small pool of dispatcher threads. Each dispatcher runs the continuous-
+batching loop:
+
+1. **Pop** the oldest request (the batch seed).
+2. **Admit** — coalesce compatible queued requests into the forming batch
+   while the grown batch's bucket still meets the tightest admitted
+   deadline with margin (:class:`~.admission.AdmissionController`), waiting
+   in sub-millisecond quanta for more traffic only while that same check
+   says the wait is affordable (admit-until-deadline-margin, not a fixed
+   drain tick).
+3. **Dispatch** OUTSIDE the admission lock: concatenate rows, let
+   ``model.output`` pad up the shared bucket ladder (one executable per
+   bucket; AOT-warmed at registration so the request path never compiles),
+   slice results back per request, measure the execution latency into the
+   :class:`~.admission.LatencyModel`.
+
+Overload protection is fail-fast, never queue-unboundedly:
+
+- **Backpressure** — a full queue sheds at submit (→ HTTP 429).
+- **Deadline shedding** — a request whose measured bucket latency cannot
+  meet its deadline is shed at arrival, and one that expires while queued
+  is shed at assembly instead of wasting a dispatch (→ HTTP 503).
+
+Both paths record ``dl4j_requests_total{status="shed"}`` +
+``dl4j_shed_total{reason}`` and burn SLO error budget (obs/slo.py), so the
+burn-rate gauge reacts to overload exactly as it does to latency misses.
+
+Lock discipline (enforced by graftlint's lock-discipline rule): everything
+under ``self._cond`` is host-side queue/float arithmetic — the device
+dispatch, the result materialization, and the per-request fan-out all
+happen with the lock released, so producers are never stalled behind XLA.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu import obs
+from deeplearning4j_tpu.serve.admission import (
+    AdmissionController, LatencyModel, ServeConfig)
+from deeplearning4j_tpu.utils import bucketing
+
+__all__ = ["ModelWorker", "ShedError", "ServeConfig"]
+
+
+class ShedError(RuntimeError):
+    """A request the serving tier refused to run. ``reason`` is
+    ``backpressure`` (queue full → HTTP 429), ``deadline`` (cannot meet the
+    request's deadline → HTTP 503) or ``shutdown`` (→ HTTP 503)."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(detail or reason)
+        self.reason = reason
+
+    @property
+    def http_status(self) -> int:
+        return 429 if self.reason == "backpressure" else 503
+
+
+class _Req:
+    __slots__ = ("x", "rows", "deadline", "arrival", "event", "result",
+                 "error")
+
+    def __init__(self, x, deadline: float, arrival: float):
+        self.x = x
+        self.rows = len(x)
+        self.deadline = deadline
+        self.arrival = arrival
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[Exception] = None
+
+
+class ModelWorker:
+    """Deadline-aware continuous-batching front for ONE model.
+
+    ``submit`` blocks the calling thread until its rows come back (or
+    raises :class:`ShedError`); the dispatcher pool coalesces concurrent
+    callers into bucket-ladder batches. ``latency`` may be shared across
+    workers (the registry shares one :class:`LatencyModel` so /metrics has
+    a single family) — estimates are keyed per model name.
+    """
+
+    def __init__(self, name: str, model, config: Optional[ServeConfig] = None,
+                 latency: Optional[LatencyModel] = None,
+                 ladder: Optional[bucketing.BucketLadder] = None):
+        self.name = name
+        self.model = model
+        self.config = config or ServeConfig.from_env()
+        self.route = f"serve.{name}"
+        self.latency = latency or LatencyModel(
+            min_samples=self.config.min_samples)
+        self.admission = AdmissionController(self.latency, self.config,
+                                             ladder=ladder)
+        self._cond = threading.Condition()
+        self._q: List[_Req] = []
+        self._stop = False
+        self._shed_seen: set = set()
+        self._batches = obs.counter(
+            "dl4j_serve_batches_total",
+            "coalesced dispatches by model", ("model",))
+        self._batch_rows = obs.histogram(
+            "dl4j_serve_batch_rows",
+            "real rows per coalesced dispatch (fill, before bucket padding)",
+            ("model",))
+        self._depth = obs.gauge(
+            "dl4j_serve_queue_depth",
+            "requests waiting in the per-model serving queue", ("model",))
+        self._threads = [
+            threading.Thread(target=self._worker_loop, daemon=True,
+                             name=f"serve-{name}-{i}")
+            for i in range(max(1, self.config.workers))]
+        for t in self._threads:
+            t.start()
+
+    # -- producer side -----------------------------------------------------
+
+    def submit(self, x, deadline_s: Optional[float] = None) -> np.ndarray:
+        """Serve one request of ``len(x)`` rows. ``deadline_s`` is relative
+        to now (defaults to ``ServeConfig.default_deadline_s``); the call
+        blocks until the rows are served, or raises :class:`ShedError` /
+        the model's own failure."""
+        x = np.asarray(x)
+        if x.ndim < 1 or len(x) == 0:
+            raise ValueError("request must carry at least one row")
+        now = time.perf_counter()
+        if deadline_s is None:
+            deadline_s = self.config.default_deadline_s
+        r = _Req(x, now + deadline_s, now)
+        # arrival feasibility BEFORE touching the queue: a request whose
+        # bucket measurably overruns its own deadline wastes queue space
+        # and device time — reject it while it is cheapest (503 semantics)
+        if self.admission.infeasible(self.name, r.rows, r.deadline, now):
+            self._shed(r, "deadline")
+            raise ShedError("deadline",
+                            f"{self.name}: measured bucket latency cannot "
+                            f"meet deadline {deadline_s * 1e3:.1f}ms")
+        with self._cond:
+            if self._stop:
+                raise ShedError("shutdown", f"{self.name}: worker shut down")
+            if len(self._q) >= self.config.queue_limit:
+                depth = len(self._q)
+                shed = True
+            else:
+                shed = False
+                self._q.append(r)
+                depth = len(self._q)
+                self._cond.notify()
+        self._depth.set(depth, model=self.name)
+        if shed:
+            self._shed(r, "backpressure")
+            raise ShedError("backpressure",
+                            f"{self.name}: queue full ({depth} waiting)")
+        r.event.wait()
+        if r.error is not None:
+            raise r.error
+        return r.result
+
+    # -- shed accounting ---------------------------------------------------
+
+    def _shed(self, r: _Req, reason: str):
+        obs.observe_shed(self.route, reason=reason)
+        if reason not in self._shed_seen:  # first occurrence: one event
+            self._shed_seen.add(reason)
+            obs.event("serve_shed", model=self.name, reason=reason,
+                      rows=int(r.rows))
+
+    # -- dispatcher side ---------------------------------------------------
+
+    def _worker_loop(self):
+        while True:
+            with self._cond:
+                while not self._q and not self._stop:
+                    self._cond.wait()
+                if self._stop and not self._q:
+                    return
+                first = self._q.pop(0)
+                depth = len(self._q)
+            self._depth.set(depth, model=self.name)
+            batch = self._assemble(first)
+            if batch:
+                self._dispatch(batch)
+
+    def _assemble(self, first: _Req) -> List[_Req]:
+        """The admission loop: grow [first] while the admission controller
+        approves, shedding queued requests that expired. Returns the batch
+        to dispatch (possibly empty if every candidate expired)."""
+        cfg = self.config
+        batch: List[_Req] = []
+        rows = 0
+        tightest = float("inf")
+        opened = time.perf_counter()
+        candidate: Optional[_Req] = first
+        while True:
+            now = time.perf_counter()
+            if candidate is not None:
+                merged = min(tightest, candidate.deadline)
+                if now + cfg.margin_s > candidate.deadline:
+                    # expired while queued: a late response is a failed
+                    # response that also ate device time — shed instead
+                    self._shed(candidate, "deadline")
+                    candidate.error = ShedError(
+                        "deadline", f"{self.name}: deadline expired in queue")
+                    candidate.event.set()
+                elif not batch or self.admission.admit_more(
+                        self.name, rows, candidate.rows, merged, now):
+                    batch.append(candidate)
+                    rows += candidate.rows
+                    tightest = merged
+                else:
+                    # would overrun the tightest admitted deadline (or the
+                    # batch cap): leave it at the queue head for the next
+                    # batch — this batch dispatches on the last bucket that
+                    # stays feasible
+                    with self._cond:
+                        self._q.insert(0, candidate)
+                    break
+                candidate = None
+                continue
+            if rows >= cfg.max_batch:
+                break
+            with self._cond:
+                if self._q:
+                    candidate = self._q.pop(0)
+                    continue
+            if self._stop or now - opened >= cfg.max_wait_s:
+                break
+            if batch and not self.admission.can_wait(
+                    self.name, rows, tightest, now):
+                break
+            time.sleep(cfg.wait_quantum_s)
+        return batch
+
+    def _dispatch(self, batch: List[_Req]):
+        total = sum(r.rows for r in batch)
+        bucket = (bucketing.bucket_size(total)
+                  if bucketing.bucketing_enabled() else total)
+        bucketing.telemetry().record_hit(self.route, total, bucket)
+        try:
+            xs = (batch[0].x if len(batch) == 1
+                  else np.concatenate([r.x for r in batch], axis=0))
+            t0 = time.perf_counter()
+            # model.output pads up the shared ladder itself, so this
+            # dispatch hits the SAME executable (and AOT warm entry) a
+            # direct caller would — the basis of coalescing bit-exactness
+            out = np.asarray(self.model.output(xs))
+            dt = time.perf_counter() - t0
+            self.latency.observe(self.name, bucket, dt)
+            self._batches.inc(model=self.name)
+            self._batch_rows.observe(total, model=self.name)
+            done = time.perf_counter()
+            ofs = 0
+            for r in batch:
+                r.result = out[ofs:ofs + r.rows]
+                ofs += r.rows
+                r.event.set()
+                obs.observe_request(self.route, done - r.arrival,
+                                    status="ok")
+        except Exception as e:  # propagate to every waiter, keep serving
+            done = time.perf_counter()
+            for r in batch:
+                r.error = e
+                r.event.set()
+                obs.observe_request(self.route, done - r.arrival,
+                                    status="error", error=True)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def stats(self) -> Dict[str, object]:
+        with self._cond:
+            depth = len(self._q)
+        return {
+            "model": self.name,
+            "queue_depth": depth,
+            "queue_limit": self.config.queue_limit,
+            "max_batch": self.config.max_batch,
+            "batches": int(self._batches.value(model=self.name)),
+            "workers": len(self._threads),
+        }
+
+    def shutdown(self, timeout_s: float = 5.0):
+        with self._cond:
+            self._stop = True
+            stranded = list(self._q)
+            self._q.clear()
+            self._cond.notify_all()
+        for r in stranded:
+            r.error = ShedError("shutdown", f"{self.name}: worker shut down")
+            r.event.set()
+        for t in self._threads:
+            t.join(timeout=timeout_s)

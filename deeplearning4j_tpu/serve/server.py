@@ -1,0 +1,133 @@
+"""HTTP/JSON inference server over the continuous-batching scheduler.
+
+Routes (stdlib ThreadingHTTPServer — one OS thread per connection, which
+is exactly what the coalescing scheduler wants: concurrent blocked
+``submit`` calls ARE the batch):
+
+- ``POST /v1/models/<name>:predict`` with ``{"inputs": [[...], ...],
+  "deadline_ms": 50}`` → ``{"outputs": [...], "rows": n}``. Status codes
+  carry the overload semantics end to end: 200 served, 400 malformed
+  payload, 404 unknown model, **429** shed by queue backpressure (with
+  ``Retry-After``), **503** shed because the deadline is infeasible or
+  already expired;
+- ``GET /v1/models`` → per-model pool stats (queue depth, batches, warm
+  metadata);
+- ``GET /healthz``, ``GET /metrics`` — from serve/httpcommon.py; /metrics
+  exposes the whole obs registry including ``dl4j_requests_total``,
+  ``dl4j_shed_total`` and ``dl4j_slo_burn_rate`` for the serve routes.
+
+SLO route labels are collapsed to ``serve.<name>:http`` / ``/v1/models`` /
+``/metrics`` … so label cardinality stays bounded by the model count, not
+the URL space.
+
+The launcher (``python -m deeplearning4j_tpu.serve``) builds the registry
+from ``name=path`` arguments — each runs the import → AOT-warm → serve
+pipeline (serve/registry.py) BEFORE the socket binds, so a server that
+answers its port never compiles on the request path.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+from urllib.parse import urlparse
+
+import numpy as np
+
+from deeplearning4j_tpu import obs
+from deeplearning4j_tpu.serve import httpcommon
+from deeplearning4j_tpu.serve.admission import ServeConfig
+from deeplearning4j_tpu.serve.registry import ModelRegistry
+from deeplearning4j_tpu.serve.scheduler import ShedError
+
+__all__ = ["InferenceServer"]
+
+_PREDICT_RE = re.compile(r"^/v1/models/([\w.\-]+):predict$")
+
+
+class InferenceServer:
+    """``InferenceServer(registry).start(port)`` — see module docstring."""
+
+    def __init__(self, registry: Optional[ModelRegistry] = None,
+                 config: Optional[ServeConfig] = None):
+        self.registry = registry or ModelRegistry(config=config)
+        self._httpd = None
+        self._thread = None
+        self.port: Optional[int] = None
+        self._inflight = httpcommon.InFlight()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, port: int = 0) -> "InferenceServer":
+        outer = self
+
+        class Handler(httpcommon.ObservedHandler):
+            inflight = outer._inflight
+
+            def slo_route(self, path: str) -> str:
+                m = _PREDICT_RE.match(path)
+                return f"serve.{m.group(1)}:http" if m else path
+
+            def handle_get(self) -> int:
+                if urlparse(self.path).path == "/v1/models":
+                    return self.send_json(200,
+                                          {"models": outer.registry.describe()})
+                self.send_response(404)
+                self.end_headers()
+                return 404
+
+            def handle_post(self) -> int:
+                m = _PREDICT_RE.match(urlparse(self.path).path)
+                if not m:
+                    return self.send_json(404, {"error": "no such route"})
+                worker = outer.registry.worker(m.group(1))
+                if worker is None:
+                    return self.send_json(
+                        404, {"error": f"model {m.group(1)!r} not served",
+                              "served": outer.registry.names()})
+                try:
+                    payload = self.read_json()
+                    x = np.asarray(payload["inputs"], dtype=np.float32)
+                    deadline_ms = payload.get("deadline_ms")
+                    deadline_s = (None if deadline_ms is None
+                                  else float(deadline_ms) / 1e3)
+                    if deadline_s is not None and deadline_s <= 0:
+                        raise ValueError("deadline_ms must be > 0")
+                except Exception as e:
+                    return self.send_json(400, {"error": str(e)})
+                try:
+                    out = worker.submit(x, deadline_s=deadline_s)
+                except ShedError as e:
+                    body = {"error": str(e), "shed": e.reason}
+                    if e.http_status == 429:
+                        # closed-loop clients back off for one deadline's
+                        # worth of queue drain rather than hammering
+                        return self.send_json(
+                            429, body,
+                            headers=(("Retry-After", "1"),))
+                    return self.send_json(503, body)
+                except ValueError as e:
+                    return self.send_json(400, {"error": str(e)})
+                except Exception as e:
+                    return self.send_json(500, {"error": str(e)})
+                return self.send_json(200, {
+                    "outputs": np.asarray(out).tolist(),
+                    "rows": int(len(out)),
+                })
+
+        self._httpd, self._thread, self.port = httpcommon.start_server(
+            Handler, port)
+        obs.event("serve_started", port=self.port,
+                  models=",".join(self.registry.names()))
+        return self
+
+    def stop(self, shutdown_registry: bool = True) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            if self._thread:
+                self._thread.join(timeout=10)
+                self._thread = None
+        if shutdown_registry:
+            self.registry.shutdown()

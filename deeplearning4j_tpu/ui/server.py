@@ -12,8 +12,6 @@ from __future__ import annotations
 
 import html
 import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Sequence, Tuple
 
 from deeplearning4j_tpu.ui.i18n import I18N
@@ -144,19 +142,6 @@ class UIServer:
         # /tsne embedding page (reference deeplearning4j-play
         # module/tsne/TsneModule.java): named 2-D point sets + labels
         self._tsne_sets: dict = {}
-        # serving SLOs: requests currently inside a handler (all routes)
-        self._inflight = 0
-        self._inflight_lock = threading.Lock()
-
-    def _note_inflight(self, delta: int) -> None:
-        from deeplearning4j_tpu import obs
-
-        with self._inflight_lock:
-            self._inflight += delta
-            v = self._inflight
-        if obs.enabled():
-            obs.gauge("dl4j_http_in_flight",
-                      "HTTP requests currently being served").set(v)
 
     @classmethod
     def get_instance(cls) -> "UIServer":
@@ -348,41 +333,17 @@ class UIServer:
 
             for m in warm_models:
                 aot.warm_serving(m, warm_batch)
+        # SLO envelope, in-flight gauge, /metrics and /healthz all come
+        # from the shared plumbing (serve/httpcommon.py) — the UI handler
+        # only contributes its dashboard routes
+        from deeplearning4j_tpu.serve import httpcommon
+
         outer = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
+        class Handler(httpcommon.ObservedHandler):
+            inflight = httpcommon.InFlight()
 
-            def _observed(self, handler):
-                """Serving-SLO envelope around every request: in-flight
-                gauge, per-route latency histogram, burn rate (obs/slo.py).
-                ``handler`` returns the response status it sent."""
-                import time as _time
-
-                from urllib.parse import urlparse
-
-                from deeplearning4j_tpu import obs
-
-                route = urlparse(self.path).path
-                outer._note_inflight(1)
-                t0 = _time.perf_counter()
-                status = 500
-                try:
-                    status = handler()
-                finally:
-                    outer._note_inflight(-1)
-                    obs.observe_request(
-                        route, _time.perf_counter() - t0,
-                        status=str(status), error=status >= 500)
-
-            def do_GET(self):
-                self._observed(self._handle_get)
-
-            def do_POST(self):
-                self._observed(self._handle_post)
-
-            def _handle_get(self) -> int:
+            def handle_get(self) -> int:
                 from urllib.parse import parse_qs, urlparse
 
                 parsed = urlparse(self.path)
@@ -406,14 +367,6 @@ class UIServer:
                         {"sessions": st.list_session_ids()} for st in outer.storages
                     ]).encode()
                     ctype = "application/json"
-                elif route == "/metrics":
-                    # Prometheus text exposition of the process-wide obs
-                    # registry (bucketing, comm bytes, checkpoint durations,
-                    # guard events, span summaries)
-                    from deeplearning4j_tpu import obs
-
-                    body = obs.prometheus_text().encode()
-                    ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif route == "/debug/trace":
                     # live Chrome/Perfetto trace of the span ring + event
                     # log (load in ui.perfetto.dev / chrome://tracing)
@@ -426,43 +379,30 @@ class UIServer:
                     self.send_response(404)
                     self.end_headers()
                     return 404
-                self.send_response(200)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-                return 200
+                return self.send_body(200, body, ctype)
 
-            def _handle_post(self) -> int:
+            def handle_post(self) -> int:
                 from urllib.parse import urlparse
 
                 if urlparse(self.path).path == "/tsne":
                     # TsneModule upload parity: JSON {coords, labels?, name?}
                     try:
-                        n = int(self.headers.get("Content-Length", "0"))
-                        payload = json.loads(self.rfile.read(n).decode("utf-8"))
+                        payload = self.read_json()
                         outer.upload_tsne(payload["coords"],
                                           payload.get("labels"),
                                           session_id=str(payload.get("name",
                                                                      "tsne")))
                     except Exception as e:
-                        self.send_response(400)
-                        self.end_headers()
-                        self.wfile.write(str(e).encode())
-                        return 400
-                    self.send_response(200)
-                    self.send_header("Content-Length", "2")
-                    self.end_headers()
-                    self.wfile.write(b"ok")
-                    return 200
+                        return self.send_body(400, str(e).encode(),
+                                              "text/plain")
+                    return self.send_body(200, b"ok", "text/plain")
                 if urlparse(self.path).path != "/remote" \
                         or outer._remote_storage is None:
                     self.send_response(404)
                     self.end_headers()
                     return 404
                 try:
-                    n = int(self.headers.get("Content-Length", "0"))
-                    payload = json.loads(self.rfile.read(n).decode("utf-8"))
+                    payload = self.read_json()
                     records = payload if isinstance(payload, list) else [payload]
                     # validate the WHOLE batch before applying any record:
                     # a mid-batch failure must not store a partial batch the
@@ -481,10 +421,7 @@ class UIServer:
                     staged = [(rec.pop("_kind", "update"), rec)
                               for rec in records]
                 except Exception as e:  # any bad payload -> 400, keep serving
-                    self.send_response(400)
-                    self.end_headers()
-                    self.wfile.write(str(e).encode())
-                    return 400
+                    return self.send_body(400, str(e).encode(), "text/plain")
                 try:
                     for kind, rec in staged:
                         if kind == "static":
@@ -492,20 +429,11 @@ class UIServer:
                         else:
                             outer._remote_storage.put_update(rec)
                 except Exception as e:  # storage fault: 500, keep serving
-                    self.send_response(500)
-                    self.end_headers()
-                    self.wfile.write(str(e).encode())
-                    return 500
-                self.send_response(200)
-                self.send_header("Content-Length", "2")
-                self.end_headers()
-                self.wfile.write(b"ok")
-                return 200
+                    return self.send_body(500, str(e).encode(), "text/plain")
+                return self.send_body(200, b"ok", "text/plain")
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
-        self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
-        self._thread.start()
+        self._httpd, self._thread, self.port = httpcommon.start_server(
+            Handler, port)
         return self
 
     def stop(self) -> None:

@@ -1,0 +1,50 @@
+"""graftlint fixture: host sync / jitted dispatch while holding a lock."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_LOCK = threading.Lock()
+_RESULTS = {}
+
+
+def fwd(params, x):
+    return jnp.dot(x, params)
+
+
+_jit_fwd = jax.jit(fwd)
+
+
+def dispatch_under_lock(params, x):
+    with _LOCK:
+        out = _jit_fwd(params, x)       # BAD: XLA runs while lock is held
+        _RESULTS["last"] = out
+    return out
+
+
+def sync_under_lock(params, x):
+    out = _jit_fwd(params, x)
+    with _LOCK:
+        v = float(out.sum())            # BAD: blocks all lock waiters
+        w = np.asarray(out)             # BAD: materializes under the lock
+        g = jax.device_get(out)         # BAD: explicit transfer under lock
+        _RESULTS["v"] = v
+    return v, w, g
+
+
+def sync_outside_lock(params, x):
+    out = _jit_fwd(params, x)
+    v = float(out.sum())                # good: sync with no lock held
+    with _LOCK:
+        _RESULTS["v"] = v               # good: host-side dict write only
+    return v
+
+
+def sync_suppressed(params, x):
+    out = _jit_fwd(params, x)
+    with _LOCK:
+        v = float(out.sum())  # graftlint: disable=lock-discipline
+        _RESULTS["v"] = v
+    return v

@@ -397,10 +397,19 @@ class TestVGG16BNPipeline:
         rs = np.random.RandomState(0)
         x = rs.rand(8, 32, 32, 3).astype(np.float32)
         y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 8)]
-        l0 = float(tr.fit_batch(x, y))
-        losses = [float(tr.fit_batch(x, y)) for _ in range(5)]
-        assert np.isfinite(l0) and all(np.isfinite(l) for l in losses)
-        assert losses[-1] < l0
+        # fit_batch losses carry 0.5-dropout sampling noise (a fresh mask
+        # per micro-batch), so consecutive values oscillate without any
+        # visible trend over a handful of steps — pipelined and
+        # single-process runs oscillate identically. Assert descent of the
+        # DETERMINISTIC training loss instead: unravel the stage vectors
+        # back into an ordinary network and score with train-mode batch
+        # statistics and dropout off (score(train=True)).
+        s0 = tr.to_model().score((x, y), train=True)
+        losses = [float(tr.fit_batch(x, y)) for _ in range(6)]
+        assert all(np.isfinite(l) for l in losses)
+        s1 = tr.to_model().score((x, y), train=True)
+        assert np.isfinite(s0) and np.isfinite(s1)
+        assert s1 < s0
 
 
 class TestTransformerPipeline:

@@ -82,6 +82,25 @@ class TestRuleTruePositives:
         # mutation under the lock is clean
         assert not _hits(fs, "lock-discipline", "locks_bad.py", "put_locked")
 
+    def test_lock_discipline_hot_sync(self, fixture_findings):
+        """The serving-scheduler sub-check: no host sync / jitted dispatch
+        while holding a lock (serve/scheduler.py's admission loop)."""
+        fs = fixture_findings
+        assert _hits(fs, "lock-discipline", "locks_hot_bad.py",
+                     "dispatch_under_lock")
+        under = _hits(fs, "lock-discipline", "locks_hot_bad.py",
+                      "sync_under_lock")
+        msgs = " ".join(f.message for f in under)
+        assert "float()" in msgs            # scalar coercion under the lock
+        assert "np.asarray" in msgs         # materialization under the lock
+        assert "device_get" in msgs         # explicit transfer under the lock
+        # the same syncs with the lock released are this rule's GOOD shape
+        # (host-sync still owns them on the dispatch path)
+        assert not _hits(fs, "lock-discipline", "locks_hot_bad.py",
+                         "sync_outside_lock")
+        assert not _hits(fs, "lock-discipline", "locks_hot_bad.py",
+                         "sync_suppressed")
+
     def test_monotonic_clock(self, fixture_findings):
         fs = fixture_findings
         assert _hits(fs, "monotonic-clock", "clock_bad.py", "elapsed_direct")

@@ -1,0 +1,533 @@
+"""serve/ continuous-batching inference tier (ISSUE 8).
+
+Covers: deadline-admission math (LatencyModel estimates + the controller
+truth table), coalescing bit-exactness against single-request inference,
+backpressure and deadline shedding under synthetic overload (with the SLO
+counters and burn rate reacting), multi-model pool isolation, the HTTP
+round trip with its 400/404/429/503 semantics, the registry's Keras
+import → AOT-warm → serve pipeline with a zero-compile request path, and
+ParallelInference deadline propagation.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import obs, serve
+from deeplearning4j_tpu.nn.input_type import InputType
+from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+from deeplearning4j_tpu.nn.model import (
+    MultiLayerConfiguration,
+    MultiLayerNetwork,
+)
+from deeplearning4j_tpu.obs import slo
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.serve.admission import (
+    AdmissionController,
+    LatencyModel,
+    ServeConfig,
+)
+from deeplearning4j_tpu.serve.scheduler import ModelWorker, ShedError
+from deeplearning4j_tpu.utils import bucketing
+
+FIX = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for var in ("DL4J_TPU_SERVE_MAX_BATCH", "DL4J_TPU_SERVE_QUEUE",
+                "DL4J_TPU_SERVE_MARGIN_MS", "DL4J_TPU_SERVE_WAIT_MS",
+                "DL4J_TPU_SERVE_WAIT_QUANTUM_MS",
+                "DL4J_TPU_SERVE_DEFAULT_DEADLINE_MS",
+                "DL4J_TPU_SERVE_MIN_SAMPLES", "DL4J_TPU_SERVE_WORKERS",
+                "DL4J_TPU_SLO_LATENCY_MS", "DL4J_TPU_AOT",
+                "DL4J_TPU_AOT_BUNDLE", "DL4J_TPU_BUCKETING",
+                "DL4J_TPU_BUCKETS"):
+        monkeypatch.delenv(var, raising=False)
+    obs.reset()
+    bucketing.telemetry().reset()
+    yield
+    obs.reset()
+    bucketing.telemetry().reset()
+
+
+def _mln(seed=1, n_in=4):
+    conf = MultiLayerConfiguration(
+        layers=(Dense(n_out=8, activation="tanh"),
+                OutputLayer(n_out=2, activation="softmax")),
+        input_type=InputType.feed_forward(n_in),
+        updater={"type": "sgd", "lr": 0.1},
+        seed=seed,
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _x(n, n_in=4, seed=0):
+    return np.random.RandomState(seed).randn(n, n_in).astype(np.float32)
+
+
+class _SlowModel:
+    """Delegates to a real model after a fixed host-side delay — makes the
+    dispatcher's occupancy deterministic so queueing behavior is testable."""
+
+    def __init__(self, model, delay_s):
+        self._model = model
+        self.delay_s = delay_s
+        self.params = model.params
+
+    def output(self, x):
+        time.sleep(self.delay_s)
+        return self._model.output(x)
+
+
+# ---------------------------------------------------------------------------
+# Admission math
+# ---------------------------------------------------------------------------
+
+
+class TestAdmissionMath:
+    def test_config_from_env(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_SERVE_MAX_BATCH", "16")
+        monkeypatch.setenv("DL4J_TPU_SERVE_QUEUE", "9")
+        monkeypatch.setenv("DL4J_TPU_SERVE_MARGIN_MS", "2")
+        monkeypatch.setenv("DL4J_TPU_SERVE_WAIT_MS", "7")
+        monkeypatch.setenv("DL4J_TPU_SERVE_WORKERS", "3")
+        cfg = ServeConfig.from_env()
+        assert cfg.max_batch == 16
+        assert cfg.queue_limit == 9
+        assert cfg.margin_s == pytest.approx(0.002)
+        assert cfg.max_wait_s == pytest.approx(0.007)
+        assert cfg.workers == 3
+
+    def test_default_deadline_follows_slo(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_SLO_LATENCY_MS", "120")
+        assert ServeConfig.from_env().default_deadline_s == pytest.approx(0.12)
+        monkeypatch.setenv("DL4J_TPU_SERVE_DEFAULT_DEADLINE_MS", "80")
+        assert ServeConfig.from_env().default_deadline_s == pytest.approx(0.08)
+
+    def test_latency_model_trust_threshold(self):
+        lm = LatencyModel(min_samples=3)
+        assert lm.estimate("m", 8) is None          # never measured
+        lm.observe("m", 8, 0.010)
+        lm.observe("m", 8, 0.010)
+        assert lm.estimate("m", 8) is None          # below min_samples
+        lm.observe("m", 8, 0.010)
+        est = lm.estimate("m", 8)
+        assert est == pytest.approx(0.010, rel=0.05)
+
+    def test_latency_model_scales_to_unmeasured_buckets(self):
+        lm = LatencyModel(min_samples=1)
+        lm.observe("m", 8, 0.010)
+        # larger bucket: linear row scaling
+        assert lm.estimate("m", 16) == pytest.approx(0.020, rel=0.05)
+        # smaller bucket: never below the measured floor
+        assert lm.estimate("m", 4) == pytest.approx(0.010, rel=0.05)
+        # other models stay unmeasured
+        assert lm.estimate("other", 8) is None
+
+    def test_controller_truth_table(self):
+        cfg = ServeConfig(max_batch=16, margin_s=0.005,
+                          wait_quantum_s=0.001, min_samples=1)
+        lm = LatencyModel(min_samples=1)
+        ctl = AdmissionController(lm, cfg)
+        b8 = ctl._bucket(8)
+        lm.observe("m", b8, 0.010)  # measured: bucket(8) takes 10ms
+
+        # infeasible: eta(now + 10ms) + 5ms margin vs deadline
+        assert ctl.infeasible("m", 8, deadline=0.012, now=0.0)
+        assert not ctl.infeasible("m", 8, deadline=0.020, now=0.0)
+        # unmeasured models are never shed on arrival
+        assert not ctl.infeasible("other", 8, deadline=0.001, now=0.0)
+
+        # admit_more: grown batch's bucket must meet the tightest deadline
+        assert ctl.admit_more("m", 4, 4, tightest=0.020, now=0.0)
+        assert not ctl.admit_more("m", 4, 4, tightest=0.012, now=0.0)
+        # the batch cap is absolute
+        assert not ctl.admit_more("m", 16, 1, tightest=10.0, now=0.0)
+
+        # can_wait: dispatch after one more quantum must still fit
+        assert ctl.can_wait("m", 8, tightest=0.050, now=0.0)
+        assert not ctl.can_wait("m", 8, tightest=0.015, now=0.0)
+        assert not ctl.can_wait("m", 16, tightest=10.0, now=0.0)
+
+
+# ---------------------------------------------------------------------------
+# Coalescing
+# ---------------------------------------------------------------------------
+
+
+class TestCoalescing:
+    def test_coalesced_results_bit_exact(self):
+        """Requests coalesced into one device batch return the SAME BITS as
+        serving each request alone — the padding/slicing round trip and the
+        shared bucket executable change nothing."""
+        model = _mln()
+        slow = _SlowModel(model, 0.05)
+        cfg = ServeConfig(max_batch=32, queue_limit=64, max_wait_s=0.0,
+                          workers=1)
+        w = ModelWorker("m", slow, config=cfg)
+        try:
+            X = _x(21)
+            singles = [np.asarray(model.output(X[i:i + 3]))
+                       for i in range(0, 21, 3)]
+            # occupy the dispatcher with request 0, queue the rest behind
+            # it: they coalesce into one batch when the dispatcher frees
+            outs = [None] * 7
+            def call(i):
+                outs[i] = w.submit(X[i * 3:(i + 1) * 3], deadline_s=30.0)
+            threads = [threading.Thread(target=call, args=(i,))
+                       for i in range(7)]
+            threads[0].start()
+            time.sleep(0.02)            # dispatcher now inside request 0
+            for t in threads[1:]:
+                t.start()
+            for t in threads:
+                t.join()
+            batches = w.stats()["batches"]
+            assert batches < 7          # coalescing actually happened
+            for got, want in zip(outs, singles):
+                assert np.array_equal(np.asarray(got), want)  # bit-exact
+        finally:
+            w.shutdown()
+
+    def test_oversized_single_request_rejected_cap(self):
+        model = _mln()
+        cfg = ServeConfig(max_batch=8, queue_limit=4, workers=1)
+        w = ModelWorker("m", model, config=cfg)
+        try:
+            out = w.submit(_x(8), deadline_s=10.0)   # at the cap: fine
+            assert out.shape == (8, 2)
+            with pytest.raises(ValueError):
+                w.submit(np.zeros((0, 4), np.float32))
+        finally:
+            w.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Load shedding
+# ---------------------------------------------------------------------------
+
+
+class TestShedding:
+    def test_backpressure_sheds_and_burns(self):
+        model = _mln()
+        slow = _SlowModel(model, 0.05)
+        cfg = ServeConfig(max_batch=4, queue_limit=2, workers=1)
+        w = ModelWorker("bp", slow, config=cfg)
+        try:
+            sheds, oks = [], []
+            def hammer():
+                try:
+                    w.submit(_x(4), deadline_s=10.0)
+                    oks.append(1)
+                except ShedError as e:
+                    assert e.reason == "backpressure"
+                    assert e.http_status == 429
+                    sheds.append(1)
+            threads = [threading.Thread(target=hammer) for _ in range(16)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert sheds                 # queue_limit=2 vs 16 callers
+            assert oks                   # but traffic still flows
+            tracker = slo.slo_tracker()
+            assert tracker._count.value(route="serve.bp",
+                                        status="shed") == len(sheds)
+            assert tracker._shed.value(route="serve.bp",
+                                       reason="backpressure") == len(sheds)
+            assert tracker.burn_rate("serve.bp") > 0
+        finally:
+            w.shutdown()
+
+    def test_infeasible_deadline_sheds_on_arrival(self):
+        model = _mln()
+        cfg = ServeConfig(max_batch=8, margin_s=0.005, min_samples=1,
+                          workers=1)
+        w = ModelWorker("dl", model, config=cfg)
+        try:
+            # teach the latency model this bucket takes 10 seconds
+            w.latency.observe("dl", w.admission._bucket(4), 10.0)
+            with pytest.raises(ShedError) as ei:
+                w.submit(_x(4), deadline_s=0.05)
+            assert ei.value.reason == "deadline"
+            assert ei.value.http_status == 503
+            # a generous deadline still gets served
+            assert w.submit(_x(4), deadline_s=60.0).shape == (4, 2)
+            tracker = slo.slo_tracker()
+            assert tracker._shed.value(route="serve.dl",
+                                       reason="deadline") == 1
+        finally:
+            w.shutdown()
+
+    def test_expired_in_queue_sheds_at_assembly(self):
+        model = _mln()
+        slow = _SlowModel(model, 0.15)
+        cfg = ServeConfig(max_batch=4, queue_limit=8, margin_s=0.001,
+                          workers=1)
+        w = ModelWorker("ex", slow, config=cfg)
+        try:
+            errs = {}
+            def first():
+                w.submit(_x(2), deadline_s=30.0)
+            def second():
+                try:
+                    w.submit(_x(2), deadline_s=0.03)  # expires while queued
+                except ShedError as e:
+                    errs["reason"] = e.reason
+            t1 = threading.Thread(target=first)
+            t1.start()
+            time.sleep(0.05)             # dispatcher is inside request 1
+            t2 = threading.Thread(target=second)
+            t2.start()
+            t1.join(); t2.join()
+            assert errs.get("reason") == "deadline"
+        finally:
+            w.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Multi-model pools
+# ---------------------------------------------------------------------------
+
+
+class TestMultiModel:
+    def test_pools_serve_their_own_model(self):
+        a, b = _mln(seed=1), _mln(seed=2)
+        reg = serve.ModelRegistry(config=ServeConfig(max_batch=8, workers=1))
+        try:
+            reg.register("a", a, warm=False)
+            reg.register("b", b, warm=False)
+            X = _x(6)
+            want_a, want_b = np.asarray(a.output(X)), np.asarray(b.output(X))
+            assert not np.array_equal(want_a, want_b)  # distinct models
+            got = {}
+            def call(name, want):
+                got[name] = reg.worker(name).submit(X, deadline_s=10.0)
+            ts = [threading.Thread(target=call, args=("a", want_a)),
+                  threading.Thread(target=call, args=("b", want_b))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert np.array_equal(np.asarray(got["a"]), want_a)
+            assert np.array_equal(np.asarray(got["b"]), want_b)
+            assert sorted(reg.names()) == ["a", "b"]
+        finally:
+            reg.shutdown()
+
+    def test_one_pool_overload_does_not_shed_the_other(self):
+        fast, victim = _mln(seed=3), _mln(seed=4)
+        cfg = ServeConfig(max_batch=4, queue_limit=1, workers=1)
+        w_slow = ModelWorker("hog", _SlowModel(victim, 0.05), config=cfg)
+        w_fast = ModelWorker("calm", fast,
+                             config=ServeConfig(max_batch=8, queue_limit=64,
+                                                workers=1))
+        try:
+            shed_hog = []
+            def hammer():
+                try:
+                    w_slow.submit(_x(4), deadline_s=10.0)
+                except ShedError:
+                    shed_hog.append(1)
+            threads = [threading.Thread(target=hammer) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for _ in range(4):           # the calm pool keeps serving
+                assert w_fast.submit(_x(3), deadline_s=10.0).shape == (3, 2)
+            for t in threads:
+                t.join()
+            assert shed_hog
+            tracker = slo.slo_tracker()
+            assert not tracker._count.value(route="serve.calm",
+                                            status="shed")
+        finally:
+            w_slow.shutdown()
+            w_fast.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# HTTP round trip
+# ---------------------------------------------------------------------------
+
+
+def _post(port, name, payload, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/{name}:predict",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    resp = urllib.request.urlopen(req, timeout=timeout)
+    return resp.status, json.loads(resp.read()), dict(resp.headers)
+
+
+class TestHttp:
+    @pytest.fixture()
+    def server(self):
+        reg = serve.ModelRegistry(config=ServeConfig(max_batch=8, workers=1))
+        reg.register("toy", _mln(seed=7), warm=False)
+        srv = serve.InferenceServer(reg).start(port=0)
+        yield srv
+        srv.stop()
+
+    def test_predict_round_trip(self, server):
+        model = server.registry.worker("toy").model
+        X = _x(3)
+        status, body, _ = _post(server.port, "toy",
+                                {"inputs": X.tolist(), "deadline_ms": 30000})
+        assert status == 200
+        assert body["rows"] == 3
+        np.testing.assert_allclose(body["outputs"],
+                                   np.asarray(model.output(X)),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_unknown_model_404(self, server):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server.port, "nope", {"inputs": [[0, 0, 0, 0]]})
+        assert ei.value.code == 404
+        assert "toy" in json.loads(ei.value.read())["served"]
+
+    def test_bad_payload_400(self, server):
+        for payload in ({}, {"inputs": [[1, 2, 3, 4]], "deadline_ms": -5}):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(server.port, "toy", payload)
+            assert ei.value.code == 400
+
+    def test_models_health_metrics_endpoints(self, server):
+        base = f"http://127.0.0.1:{server.port}"
+        listing = json.loads(urllib.request.urlopen(f"{base}/v1/models").read())
+        assert [m["model"] for m in listing["models"]] == ["toy"]
+        health = json.loads(urllib.request.urlopen(f"{base}/healthz").read())
+        assert health == {"status": "ok"}
+        _post(server.port, "toy", {"inputs": _x(2).tolist()})
+        text = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        assert "dl4j_serve_batches_total" in text
+        assert 'dl4j_requests_total{route="serve.toy:http",status="200"}' \
+            in text
+
+    def test_infeasible_deadline_503(self, server):
+        w = server.registry.worker("toy")
+        w.latency.observe("toy", w.admission._bucket(2), 10.0)
+        w.latency.observe("toy", w.admission._bucket(2), 10.0)
+        w.latency.observe("toy", w.admission._bucket(2), 10.0)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server.port, "toy", {"inputs": _x(2).tolist(),
+                                       "deadline_ms": 5})
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["shed"] == "deadline"
+
+    def test_backpressure_429_with_retry_after(self):
+        reg = serve.ModelRegistry(
+            config=ServeConfig(max_batch=4, queue_limit=1, workers=1))
+        reg.register("toy", _SlowModel(_mln(seed=7), 0.05), warm=False)
+        srv = serve.InferenceServer(reg).start(port=0)
+        try:
+            codes, retry_after = [], []
+            def blast():
+                try:
+                    status, _, _ = _post(srv.port, "toy",
+                                         {"inputs": _x(4).tolist(),
+                                          "deadline_ms": 30000})
+                    codes.append(status)
+                except urllib.error.HTTPError as e:
+                    codes.append(e.code)
+                    if e.code == 429:
+                        retry_after.append(e.headers.get("Retry-After"))
+            threads = [threading.Thread(target=blast) for _ in range(12)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert 429 in codes
+            assert 200 in codes
+            assert retry_after and retry_after[0] is not None
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Registry pipeline: import -> warm -> serve, zero request-path compiles
+# ---------------------------------------------------------------------------
+
+
+class TestRegistryPipeline:
+    def test_keras_import_warm_serve(self):
+        reg = serve.ModelRegistry(config=ServeConfig(max_batch=8, workers=1))
+        try:
+            w = reg.load("cnn", os.path.join(FIX, "keras_cnn.h5"))
+            meta = reg.describe()[0]
+            assert meta["model_class"] == "MultiLayerNetwork"
+            assert meta["warmed"] > 0
+            assert meta["source"].endswith("keras_cnn.h5")
+            d = np.load(os.path.join(FIX, "keras_cnn_io.npz"))
+            compiles0 = bucketing.telemetry().compiles("mln.output")
+            out = w.submit(d["x"], deadline_s=60.0)
+            np.testing.assert_allclose(out, d["y"], rtol=1e-4, atol=1e-5)
+            # the warm pipeline covered every reachable bucket: serving
+            # compiled NOTHING on the request path
+            assert bucketing.telemetry().compiles("mln.output") == compiles0
+        finally:
+            reg.shutdown()
+
+    def test_import_model_format_detection(self):
+        from deeplearning4j_tpu import modelimport
+
+        m = modelimport.import_model(os.path.join(FIX, "keras_cnn.h5"))
+        assert type(m).__name__ == "MultiLayerNetwork"
+        with pytest.raises(ValueError):
+            modelimport.import_model("weights.txt")
+
+    def test_register_replaces_and_shuts_down_old_worker(self):
+        reg = serve.ModelRegistry(config=ServeConfig(max_batch=8, workers=1))
+        try:
+            w1 = reg.register("m", _mln(seed=1), warm=False)
+            w2 = reg.register("m", _mln(seed=2), warm=False)
+            assert reg.worker("m") is w2
+            with pytest.raises(ShedError):
+                w1.submit(_x(2), deadline_s=1.0)   # old pool is drained
+        finally:
+            reg.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# ParallelInference deadline propagation
+# ---------------------------------------------------------------------------
+
+
+class TestParallelInferenceDeadline:
+    def test_deadline_expired_in_queue_sheds(self):
+        model = _mln()
+        slow = _SlowModel(model, 0.15)
+        pi = ParallelInference(slow, mode="batched", max_batch_size=4,
+                               warmup=False)
+        try:
+            def first():
+                pi.output(_x(2))
+            t1 = threading.Thread(target=first)
+            t1.start()
+            time.sleep(0.05)             # worker busy inside request 1
+            with pytest.raises(ShedError) as ei:
+                pi.output(_x(2), deadline_ms=10)
+            assert ei.value.reason == "deadline"
+            t1.join()
+            tracker = slo.slo_tracker()
+            assert tracker._shed.value(route="pi.output",
+                                       reason="deadline") == 1
+        finally:
+            pi.shutdown()
+
+    def test_no_deadline_is_unchanged(self):
+        model = _mln()
+        pi = ParallelInference(model, mode="batched", max_batch_size=8,
+                               warmup=False)
+        try:
+            X = _x(5)
+            got = pi.output(X)
+            assert np.array_equal(got, np.asarray(model.output(X)))
+        finally:
+            pi.shutdown()

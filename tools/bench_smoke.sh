@@ -5,16 +5,19 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-out=$(BENCH_SMOKE=1 JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python bench.py)
-echo "$out"
+out=$(mktemp)
+trap 'rm -f "$out"' EXIT
+BENCH_SMOKE=1 JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python bench.py | tee "$out"
 
 # every registered metric present, none carrying an "error" field, and every
-# one embedding its obs.snapshot() (docs/OBSERVABILITY.md)
+# one embedding its obs.snapshot() (docs/OBSERVABILITY.md). The output goes
+# through a temp file: with the obs snapshots embedded it exceeds ARG_MAX.
 python - "$out" <<'EOF'
 import json
 import sys
 
-lines = [json.loads(l) for l in sys.argv[1].strip().splitlines()]
+with open(sys.argv[1]) as f:
+    lines = [json.loads(l) for l in f.read().strip().splitlines()]
 final = lines[-1]
 extras = final.get("extras", [])
 errors = [m for m in extras if "error" in m]
@@ -35,5 +38,15 @@ cold = next(m for m in extras if m["metric"] == "cold_start_ttfr_ms")
 if not (cold.get("gate_ttfr_bundle_lt_none")
         and cold.get("gate_zero_request_compiles")):
     sys.exit(f"bench smoke: cold_start gates failed: {cold}")
+# serving-tier acceptance gates (docs/SERVING.md): a p99 under saturation,
+# zero compiles on the request path after registry warm-up, and a forced
+# overload that SHEDS with the burn-rate gauge reacting
+srv = next(m for m in extras if m["metric"] == "serving_slo_p99")
+over = srv.get("overload", {})
+if not (srv.get("value", 0) > 0
+        and srv.get("request_path_compiles") == 0
+        and over.get("shed_total", 0) > 0
+        and over.get("burn_rate", 0) > 0):
+    sys.exit(f"bench smoke: serving_slo gates failed: {srv}")
 print(f"bench smoke OK: {len(extras)} metrics, no errors, obs embedded")
 EOF

@@ -1,0 +1,129 @@
+#!/usr/bin/env bash
+# Serving-tier smoke (docs/SERVING.md): proves the import -> AOT warm ->
+# serve pipeline end to end, one fresh process per phase:
+#   1. a warm process imports the Keras fixture, warms the serving ladder
+#      through the model registry, and persists the compiled executables as
+#      an .aotbundle next to nothing-in-particular (a temp dir);
+#   2. a COLD process restores the bundle through the same registry.load
+#      call, serves a concurrent HTTP burst with ZERO request-path
+#      compiles, answers bit-exactly whether requests are coalesced or
+#      served one at a time, and under forced overload SHEDS (429/503 +
+#      dl4j_shed_total) instead of queueing without bound.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+export DL4J_TPU_AOT_BUNDLE=1   # CPU: persistence is opt-in (docs/PERF.md)
+workdir=$(mktemp -d)
+trap 'rm -rf "$workdir"' EXIT
+
+common=$(cat <<'EOF'
+import json, os, sys, threading, time
+sys.path.insert(0, os.getcwd())
+from __graft_entry__ import _provision_cpu_mesh
+_provision_cpu_mesh(8)
+import numpy as np
+from deeplearning4j_tpu.serve import (
+    ModelRegistry, ModelWorker, ServeConfig, ShedError)
+from deeplearning4j_tpu.utils import bucketing
+
+FIXTURE = "tests/fixtures/keras_cnn.h5"
+MAX_BATCH = 8
+bundle = sys.argv[1]
+x = np.load("tests/fixtures/keras_cnn_io.npz")["x"].astype(np.float32)
+EOF
+)
+
+echo "== phase 1: warm process imports Keras model, persists ladder =="
+python - "$workdir/cnn.aotbundle" <<EOF
+$common
+reg = ModelRegistry(ServeConfig(max_batch=MAX_BATCH))
+w = reg.load("cnn", FIXTURE, bundle=bundle)
+meta = reg.describe()[0]
+assert meta["warmed"] > 0, meta
+assert os.path.exists(bundle), "bundle not persisted"
+ref = np.asarray(w.submit(x))
+np.save(os.path.join(os.path.dirname(bundle), "reference.npy"), ref)
+reg.shutdown()
+print(f"warmed {meta['warmed']} executables in {meta['warm_seconds']}s, "
+      f"bundle {os.path.getsize(bundle)} bytes")
+EOF
+
+echo "== phase 2: COLD process restores, serves, sheds under overload =="
+python - "$workdir/cnn.aotbundle" <<EOF
+$common
+import urllib.request
+from deeplearning4j_tpu.obs import slo
+from deeplearning4j_tpu.serve.server import InferenceServer
+
+tel = bucketing.telemetry()
+reg = ModelRegistry(ServeConfig(max_batch=MAX_BATCH))
+w = reg.load("cnn", FIXTURE, bundle=bundle)
+meta = reg.describe()[0]
+assert meta["restored"] > 0, f"cold process restored nothing: {meta}"
+compiles_warm = tel.compiles("mln.output") + tel.compiles("cg.output")
+
+# -- individually-served vs coalesced: bit-exact ------------------------
+solo = [np.asarray(w.submit(x[i:i + 1])) for i in range(len(x))]
+ref = np.load(os.path.join(os.path.dirname(bundle), "reference.npy"))
+
+srv = InferenceServer(reg, reg.config).start(port=0)
+url = f"http://127.0.0.1:{srv.port}/v1/models/cnn:predict"
+
+def predict(rows):
+    body = json.dumps({"inputs": rows.tolist()}).encode()
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return np.asarray(json.loads(resp.read())["outputs"],
+                          dtype=np.float32)
+
+# concurrent burst: single dispatcher, so overlapping submits coalesce
+outs = [None] * len(x)
+def burst(i):
+    outs[i] = predict(x[i:i + 1])
+threads = [threading.Thread(target=burst, args=(i,)) for i in range(len(x))]
+for t in threads: t.start()
+for t in threads: t.join()
+for i in range(len(x)):
+    assert np.array_equal(outs[i][0], solo[i][0]), \
+        f"row {i}: coalesced != individually served"
+    assert np.array_equal(solo[i][0], ref[i]), \
+        f"row {i}: cold restore != warm process"
+
+compiles = (tel.compiles("mln.output") + tel.compiles("cg.output")
+            - compiles_warm)
+assert compiles == 0, f"request path compiled {compiles}x after warm-up"
+
+# -- forced overload: starved queue MUST shed, burn rate MUST react -----
+over = ModelWorker("cnn_overload", reg.worker("cnn").model,
+                   config=ServeConfig(max_batch=4, queue_limit=1),
+                   latency=reg.latency)
+shed = [0]
+shed_lock = threading.Lock()
+def hammer(t):
+    for i in range(40):
+        try:
+            over.submit(x[:2], deadline_s=0.05)
+        except ShedError:
+            with shed_lock:
+                shed[0] += 1
+hthreads = [threading.Thread(target=hammer, args=(t,)) for t in range(12)]
+for t in hthreads: t.start()
+for t in hthreads: t.join()
+over.shutdown()
+
+tracker = slo.slo_tracker()
+shed_total = tracker._count.value(route="serve.cnn_overload", status="shed")
+burn = tracker.burn_rate("serve.cnn_overload")
+assert shed[0] > 0 and shed_total and shed_total > 0, \
+    f"forced overload did not shed (client={shed[0]}, metric={shed_total})"
+assert burn and burn > 0, f"burn-rate gauge did not react: {burn}"
+
+srv.stop()
+print(f"restored {meta['restored']} executables; {len(x)} coalesced HTTP "
+      f"requests bit-exact vs solo and warm process; 0 request-path "
+      f"compiles; overload shed {shed_total} (burn rate {burn})")
+EOF
+
+echo "serve smoke OK"
