@@ -81,6 +81,7 @@ __all__ = [
     "reachable_buckets",
     "restore_bundle",
     "save_bundle",
+    "toolchain_fingerprint",
     "validate_persistence",
     "warm_dp",
     "warm_fit",
@@ -635,7 +636,12 @@ def bundle_path_for(checkpoint_path) -> str:
     return os.fspath(checkpoint_path) + ".aotbundle"
 
 
-def _manifest(model, entries) -> dict:
+def toolchain_fingerprint() -> dict:
+    """The (jax, jaxlib, backend) triple that decides whether a persisted
+    artifact — executable bundle or tuning-DB entry — can still be trusted.
+    Shared by the bundle manifest and ``deeplearning4j_tpu.tune``: a knob
+    choice measured on one toolchain is as stale as a serialized executable
+    compiled on it."""
     import jax
 
     try:
@@ -645,10 +651,16 @@ def _manifest(model, entries) -> dict:
     except ImportError:  # pragma: no cover - jaxlib always ships with jax
         jaxlib_version = "unknown"
     return {
-        "format_version": BUNDLE_FORMAT_VERSION,
         "jax_version": jax.__version__,
         "jaxlib_version": jaxlib_version,
         "backend": jax.default_backend(),
+    }
+
+
+def _manifest(model, entries) -> dict:
+    return {
+        "format_version": BUNDLE_FORMAT_VERSION,
+        **toolchain_fingerprint(),
         "model_signature": None if model is None else model_signature(model),
         "entries": entries,
     }

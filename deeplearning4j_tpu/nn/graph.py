@@ -903,6 +903,12 @@ class ComputationGraph:
         guard = getattr(self, "divergence_guard", None)
         g_skip = bool(guard is not None and guard.policy == "skip_batch")
         g_limit = None if guard is None else guard.spike_limit
+        # gradient-accumulation micro-batch count, baked at step-build time
+        # (policy shared with MultiLayerNetwork — nn/model.py)
+        from deeplearning4j_tpu.nn.model import (
+            _accum_applicable, _accum_value_and_grad, _grad_accum_from_env)
+
+        accum = _grad_accum_from_env()
 
         def step(params, opt_state, state, it, rng, inputs, labels, fmasks, lmasks,
                  carries, ex_weight=None):
@@ -911,18 +917,38 @@ class ComputationGraph:
                 "cg.step", np.shape(next(iter(inputs.values()))))
             if grad_exchange is not None:
                 opt_state, residuals = opt_state
-            rngs = list(jax.random.split(rng, len(order)))
-
-            def loss_fn(p):
-                return self._loss(p, state, inputs, labels, fmasks, lmasks, rngs,
-                                  ex_weight=ex_weight,
-                                  carries=carries if with_carries else None)
-
+            batch = (inputs, labels, fmasks, lmasks, ex_weight)
             # trace-time phase spans: fire once per compile, attributing
             # trace cost per phase (runtime attribution: DL4J_TPU_PHASE_SPANS)
-            with obs.span("phase.bwd", mode="trace"):
-                ((loss, (new_state, new_carries)), grads) = jax.value_and_grad(
-                    loss_fn, has_aux=True)(params)
+            if not with_carries and _accum_applicable(accum, batch):
+                # DL4J_TPU_GRAD_ACCUM: scan over micro-batches, average the
+                # grads, run the (single) update/exchange below on the mean —
+                # grad_exchange therefore still exchanges ONCE per step
+                def make_loss_fn(mb, st, k):
+                    in_i, lab_i, fm_i, lm_i, ew_i = mb
+                    rngs_i = list(jax.random.split(k, len(order)))
+
+                    def loss_fn(p):
+                        return self._loss(p, st, in_i, lab_i, fm_i, lm_i,
+                                          rngs_i, ex_weight=ew_i, carries=None)
+
+                    return loss_fn
+
+                with obs.span("phase.bwd", mode="trace"):
+                    loss, new_state, grads = _accum_value_and_grad(
+                        accum, params, state, batch, rng, make_loss_fn)
+                new_carries = None
+            else:
+                rngs = list(jax.random.split(rng, len(order)))
+
+                def loss_fn(p):
+                    return self._loss(p, state, inputs, labels, fmasks, lmasks,
+                                      rngs, ex_weight=ex_weight,
+                                      carries=carries if with_carries else None)
+
+                with obs.span("phase.bwd", mode="trace"):
+                    ((loss, (new_state, new_carries)), grads) = jax.value_and_grad(
+                        loss_fn, has_aux=True)(params)
             if grad_exchange is not None:
                 loss = grad_exchange.mean_loss(loss)
                 new_state = grad_exchange.mean_state(new_state)
@@ -1111,6 +1137,14 @@ class ComputationGraph:
             if resilience.resume(self, resume_from) is not None:
                 resume_skip = int(getattr(self, "batch_in_epoch", 0))
                 epochs = max(epochs - self.epoch, 0)
+        import os as _os
+
+        if _os.environ.get("DL4J_TPU_TUNE"):
+            # persisted tuner winner, applied before chain/warm/step-build
+            # read their envs (same hook as MultiLayerNetwork.fit)
+            from deeplearning4j_tpu import tune as _tune
+
+            _tune.maybe_apply(self, "fit")
         guard = getattr(self, "divergence_guard", None)
         if aot.enabled():
             # time-to-first-step becomes a warm-path number: compile (or

@@ -207,6 +207,96 @@ def _chain_k_from_env(uses_rng: bool, n_params: int) -> int:
     return 8 if (not uses_rng and n_params < CHAIN_AUTO_PARAM_LIMIT) else 0
 
 
+_GRAD_ACCUM_WARNED = False
+
+
+def _grad_accum_from_env() -> int:
+    """Micro-batch count for gradient accumulation inside the jitted step
+    (DL4J_TPU_GRAD_ACCUM, default 1 = off). Shared by MultiLayerNetwork and
+    ComputationGraph; read at step-BUILD time, so a change after the first
+    compile needs ``_clear_compiled()`` (the tuner's trial subprocesses get
+    a fresh build for free). See docs/TUNING.md."""
+    import os as _os
+
+    env = _os.environ.get("DL4J_TPU_GRAD_ACCUM", "1")
+    try:
+        return max(int(env), 1)
+    except ValueError:
+        return 1
+
+
+def _accum_applicable(accum: int, batch) -> bool:
+    """Trace-time gate for the accumulated step: every batch-major leaf must
+    share one leading row count divisible by ``accum`` (micro-batches must be
+    equal-sized for the mean-of-means loss to equal the full-batch mean).
+    Falls back to the un-accumulated step otherwise — silently for accum<=1,
+    with a one-shot warning when the knob is set but the batch doesn't fit."""
+    if accum <= 1:
+        return False
+    leaves = jax.tree_util.tree_leaves(batch)
+    if not leaves or leaves[0].ndim == 0:
+        return False
+    b = leaves[0].shape[0]
+    if b < accum or b % accum != 0 or not all(
+            l.ndim >= 1 and l.shape[0] == b for l in leaves):
+        # warn-once flag: once-per-trace IS the wanted semantic here, and
+        # the boolean never feeds the traced computation
+        global _GRAD_ACCUM_WARNED  # graftlint: disable=jit-purity
+        if not _GRAD_ACCUM_WARNED:
+            _GRAD_ACCUM_WARNED = True
+            import warnings
+
+            warnings.warn(
+                f"DL4J_TPU_GRAD_ACCUM={accum} does not divide the batch "
+                f"(leading dims {[l.shape[0] for l in leaves[:4]]}); this "
+                "step runs un-accumulated.")
+        return False
+    return True
+
+
+def _accum_value_and_grad(accum, params, state, batch, rng, make_loss_fn):
+    """Gradient accumulation: one ``lax.scan`` over ``accum`` equal
+    micro-batches INSIDE the donated step executable. Each micro-batch runs
+    forward + backward at 1/accum the activation footprint (the scan re-uses
+    one micro-batch's live activations — this is the knob that unlocks
+    batches beyond HBM); gradients accumulate in a carry and are averaged
+    once, so the single optimizer update downstream sees exactly the
+    mean-of-micro-means gradient. For equal micro-batches with no masks that
+    equals the full-batch mean bitwise up to fp summation order (the parity
+    test pins fp32 tolerance); per-micro-batch means under row masks follow
+    the same mean-of-means contract the DP replica exchange already uses.
+
+    ``batch`` is a pytree of batch-major arrays (None leaves allowed).
+    ``make_loss_fn(micro_batch, state, rng_i)`` returns the per-micro-batch
+    ``loss_fn(params) -> (loss, (new_state, aux))``. Mutable layer state
+    (BatchNorm running stats) threads micro-batch to micro-batch, matching
+    what sequential small batches would do. Per-micro rngs derive as
+    ``fold_in(rng, i)`` — a different-but-equivalent stream from the
+    un-accumulated step for models that draw randomness (same caveat as
+    chained dispatch)."""
+    micro = jax.tree_util.tree_map(
+        lambda t: t.reshape((accum, t.shape[0] // accum) + t.shape[1:]),
+        batch)
+
+    def body(carry, mb):
+        st, g_acc, loss_acc, i = carry
+        loss_fn = make_loss_fn(mb, st, jax.random.fold_in(rng, i))
+        (loss_i, (st_i, _)), g_i = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        g_acc = jax.tree_util.tree_map(lambda a, g: a + g, g_acc, g_i)
+        return (st_i, g_acc, loss_acc + loss_i, i + 1), None
+
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    (new_state, g_sum, loss_sum, _), _ = jax.lax.scan(
+        body,
+        (state, zeros, jnp.asarray(0.0, jnp.float32),
+         jnp.asarray(0, jnp.int32)),
+        micro)
+    inv = 1.0 / accum
+    grads = jax.tree_util.tree_map(lambda g: g * inv, g_sum)
+    return loss_sum * inv, new_state, grads
+
+
 def _sig_dtype(a):
     # prefer the dtype attribute: np.asarray on a device array would pull
     # it back to host just to read metadata (hurts the prefetched-fit path)
@@ -492,6 +582,8 @@ class MultiLayerNetwork:
         guard = getattr(self, "divergence_guard", None)
         g_skip = bool(guard is not None and guard.policy == "skip_batch")
         g_limit = None if guard is None else guard.spike_limit
+        # gradient-accumulation micro-batch count, baked at step-build time
+        accum = _grad_accum_from_env()
 
         def step(params, opt_state, state, it, rng, x, y, fmask, lmask, carries,
                  ex_weight=None):
@@ -499,22 +591,42 @@ class MultiLayerNetwork:
             bucketing.telemetry().record_trace("mln.step", np.shape(x))
             if grad_exchange is not None:
                 opt_state, residuals = opt_state
-            rngs = list(jax.random.split(rng, len(layers)))
-
-            def loss_fn(p):
-                return self._loss(p, state, x, y, fmask, lmask, rngs,
-                                  carries if with_carries else None,
-                                  ex_weight=ex_weight)
-
+            batch = (x, y, fmask, lmask, ex_weight)
             # phase spans here run at TRACE time (the python body executes
             # once per compile): they attribute compile cost per phase and
             # nest under the enclosing fit/compile span in the trace export.
             # Runtime per-phase wall time needs the split-dispatch mode
             # (DL4J_TPU_PHASE_SPANS=1, _fit_batch_phases).
-            with obs.span("phase.bwd", mode="trace"):
-                (loss, (new_state, new_carries)), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True
-                )(params)
+            if not with_carries and _accum_applicable(accum, batch):
+                # DL4J_TPU_GRAD_ACCUM: scan over micro-batches, average the
+                # grads, run the (single) update/exchange below on the mean —
+                # grad_exchange therefore still exchanges ONCE per step
+                def make_loss_fn(mb, st, k):
+                    x_i, y_i, fm_i, lm_i, ew_i = mb
+                    rngs_i = list(jax.random.split(k, len(layers)))
+
+                    def loss_fn(p):
+                        return self._loss(p, st, x_i, y_i, fm_i, lm_i, rngs_i,
+                                          None, ex_weight=ew_i)
+
+                    return loss_fn
+
+                with obs.span("phase.bwd", mode="trace"):
+                    loss, new_state, grads = _accum_value_and_grad(
+                        accum, params, state, batch, rng, make_loss_fn)
+                new_carries = None
+            else:
+                rngs = list(jax.random.split(rng, len(layers)))
+
+                def loss_fn(p):
+                    return self._loss(p, state, x, y, fmask, lmask, rngs,
+                                      carries if with_carries else None,
+                                      ex_weight=ex_weight)
+
+                with obs.span("phase.bwd", mode="trace"):
+                    (loss, (new_state, new_carries)), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True
+                    )(params)
 
             if grad_exchange is not None:
                 loss = grad_exchange.mean_loss(loss)
@@ -741,6 +853,15 @@ class MultiLayerNetwork:
             if resilience.resume(self, resume_from) is not None:
                 resume_skip = int(getattr(self, "batch_in_epoch", 0))
                 epochs = max(epochs - self.epoch, 0)
+        import os as _os
+
+        if _os.environ.get("DL4J_TPU_TUNE"):
+            # persisted tuner winner for this (signature, backend,
+            # toolchain) — applied BEFORE chain_k/warm/step-build read
+            # their envs, so it shapes everything compiled below
+            from deeplearning4j_tpu import tune as _tune
+
+            _tune.maybe_apply(self, "fit")
         tbptt = self.conf.backprop_type == "tbptt"
         sgd = self.conf.optimization_algo in (
             "stochastic_gradient_descent", "sgd")

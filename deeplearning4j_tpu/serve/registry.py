@@ -88,6 +88,13 @@ class ModelRegistry:
 
         if getattr(model, "params", None) is None:
             model.init()
+        import os as _os
+
+        if _os.environ.get("DL4J_TPU_TUNE"):
+            # tuner winner must land before warm_serving compiles buckets
+            from deeplearning4j_tpu import tune as _tune
+
+            _tune.maybe_apply(model, "serve")
         restored = 0
         warmed = 0
         warm_dt = 0.0

@@ -50,6 +50,7 @@ from deeplearning4j_tpu.train.earlystopping import (
     InvalidScoreIterationTerminationCondition,
     LocalFileModelSaver,
     MaxEpochsTerminationCondition,
+    MaxParamNormIterationTerminationCondition,
     MaxScoreIterationTerminationCondition,
     MaxTimeIterationTerminationCondition,
     ScoreImprovementEpochTerminationCondition,
@@ -89,6 +90,7 @@ __all__ = [
     "BestScoreEpochTerminationCondition",
     "MaxTimeIterationTerminationCondition",
     "MaxScoreIterationTerminationCondition",
+    "MaxParamNormIterationTerminationCondition",
     "InvalidScoreIterationTerminationCondition",
     "DataSetLossCalculator",
     "ClassificationScoreCalculator",

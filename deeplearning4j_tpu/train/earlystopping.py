@@ -129,6 +129,38 @@ class InvalidScoreIterationTerminationCondition(IterationTerminationCondition):
         return "InvalidScoreIterationTerminationCondition()"
 
 
+class MaxParamNormIterationTerminationCondition(IterationTerminationCondition):
+    """Divergence protection on the PARAMETERS, not the score: stop once the
+    global L2 norm of the model's parameters exceeds ``max_norm`` (or goes
+    non-finite). A stable log-softmax loss cannot overflow, and a huge
+    divergent step can even land a toy model on a perfect separator with
+    score exactly 0.0 — the parameter norm is the signal that still
+    explodes when the score cannot (docs/TEST_DEBT.md, divergence row).
+
+    ``needs_model = True``: the iteration guard passes the live model so the
+    norm is read from ``model.params``. One scalar host sync per iteration,
+    on the early-stopping path only — never inside a traced step."""
+
+    needs_model = True
+
+    def __init__(self, max_norm: float):
+        if not max_norm > 0:
+            raise ValueError(f"max_norm must be positive, got {max_norm}")
+        self.max_norm = max_norm
+
+    def terminate(self, last_score, model=None):
+        if model is None or getattr(model, "params", None) is None:
+            return False
+        sq = 0.0
+        for leaf in jax.tree_util.tree_leaves(model.params):
+            sq += float(jnp.sum(jnp.square(leaf.astype(jnp.float32))))
+        norm = math.sqrt(sq) if math.isfinite(sq) else math.inf
+        return norm > self.max_norm or not math.isfinite(norm)
+
+    def __str__(self):
+        return f"MaxParamNormIterationTerminationCondition({self.max_norm})"
+
+
 # ---------------------------------------------------------------------------
 # Score calculators
 # ---------------------------------------------------------------------------
@@ -314,7 +346,14 @@ class EarlyStoppingTrainer:
 
             def iteration_done(self, m, it, score, bs=0):
                 for c in self.conds:
-                    if c.terminate(score):
+                    # conditions that inspect model state (param norm)
+                    # declare needs_model; score-only conditions keep the
+                    # reference signature
+                    if getattr(c, "needs_model", False):
+                        hit = c.terminate(score, model=m)
+                    else:
+                        hit = c.terminate(score)
+                    if hit:
                         raise _IterGuard.Stop(c)
 
         guard = _IterGuard(cfg.iteration_termination_conditions)

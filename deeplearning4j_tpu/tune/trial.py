@@ -1,0 +1,193 @@
+"""One measured trial, run in a FRESH subprocess.
+
+``python -m deeplearning4j_tpu.tune.trial <spec.json>`` builds the model
+described by the spec, applies a knob assignment (registry-validated, via
+the same environment variables the framework reads at step-build time),
+runs a warmup round so every compile lands outside the timed window, then
+times a fit round and prints exactly one JSON result line to stdout
+(last line wins — the same contract as bench.py's cold-start arms).
+
+Fresh subprocesses are the point: trial compiles must not pollute the
+parent's AOT cache or leave tuned env values behind, and a crashed trial
+must cost the search one candidate, not the process.
+
+Spec schema (JSON)::
+
+    {
+      "model_class": "MultiLayerNetwork" | "ComputationGraph",
+      "conf_json": "<conf.to_json()>",
+      "features_shape": [B, ...] | [[B, ...], ...],   # CG: list of inputs
+      "labels_shape":   [B, ...] | [[B, ...], ...],
+      "knobs": {"grad_accum": 4, ...},                # names, not envs
+      "steps": 16, "warmup_steps": 2, "seed": 0
+    }
+
+The objective reported is measured steps/sec plus the XLA cost-model
+totals (``obs.cost_report()`` FLOPs/bytes) harvested from the same run —
+the signals docs/OBSERVABILITY.md describes, consumed here as μ-cuDNN
+consumes its per-layer measurements.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Tuple
+
+__all__ = ["apply_knobs", "build_spec", "run_trial", "main"]
+
+
+def apply_knobs(assignment: Dict[str, Any], env: Dict[str, str]) -> Dict[str, str]:
+    """Translate a name→value assignment into env-var writes on ``env``
+    (registry-validated). Returns the env delta actually written."""
+    from deeplearning4j_tpu.tune import knobs as _knobs
+
+    delta: Dict[str, str] = {}
+    for name in sorted(assignment):
+        knob = _knobs.get(name)
+        if knob is None:
+            raise KeyError(f"unknown knob {name!r}")
+        value = knob.validate(assignment[name])
+        env[knob.env] = delta[knob.env] = knob.format(value)
+    return delta
+
+
+def build_spec(model, features, labels, steps: int = 16,
+               warmup_steps: int = 2, seed: int = 0) -> Dict[str, Any]:
+    """Spec for tuning ``model`` on batches shaped like (features, labels).
+    Only shapes travel — the trial subprocess synthesizes data, so a spec
+    is a few KB regardless of dataset size."""
+    import numpy as np
+
+    def shapes(x):
+        if isinstance(x, (list, tuple)):
+            return [list(np.shape(a)) for a in x]
+        return list(np.shape(x))
+
+    return {
+        "model_class": type(model).__name__,
+        "conf_json": model.conf.to_json(),
+        "features_shape": shapes(features),
+        "labels_shape": shapes(labels),
+        "knobs": {},
+        "steps": int(steps),
+        "warmup_steps": int(warmup_steps),
+        "seed": int(seed),
+    }
+
+
+def _synth(shape, rng, one_hot: bool):
+    import numpy as np
+
+    if one_hot and len(shape) == 2:
+        # classification targets: one-hot rows keep every loss well-posed
+        idx = rng.randint(0, shape[1], size=shape[0])
+        return np.eye(shape[1], dtype=np.float32)[idx]
+    return rng.rand(*shape).astype(np.float32)
+
+
+def _synth_batch(spec) -> Tuple[Any, Any]:
+    import numpy as np
+
+    rng = np.random.RandomState(spec.get("seed", 0))
+    fs, ls = spec["features_shape"], spec["labels_shape"]
+
+    def many(shapes, one_hot):
+        if shapes and isinstance(shapes[0], list):
+            return [_synth(tuple(s), rng, one_hot) for s in shapes]
+        return _synth(tuple(shapes), rng, one_hot)
+
+    return many(fs, one_hot=False), many(ls, one_hot=True)
+
+
+def _build_model(spec):
+    cls = spec["model_class"]
+    if cls == "MultiLayerNetwork":
+        from deeplearning4j_tpu.nn.model import (MultiLayerConfiguration,
+                                                 MultiLayerNetwork)
+
+        m = MultiLayerNetwork(MultiLayerConfiguration.from_json(
+            spec["conf_json"]))
+    elif cls == "ComputationGraph":
+        from deeplearning4j_tpu.nn.graph import (ComputationGraph,
+                                                 ComputationGraphConfiguration)
+
+        m = ComputationGraph(ComputationGraphConfiguration.from_json(
+            spec["conf_json"]))
+    else:
+        raise ValueError(f"unknown model_class {cls!r}")
+    m.init()
+    return m
+
+
+def _cost_totals() -> Dict[str, float]:
+    """Sum the XLA cost-model ledger across every (site, key) this process
+    compiled — in a fresh trial subprocess that is exactly the trial's own
+    executables, nothing else."""
+    from deeplearning4j_tpu import obs
+
+    flops = 0.0
+    bytes_ = 0.0
+    try:
+        report = obs.cost_report()
+        for entries in report.get("sites", {}).values():
+            for entry in entries.values():
+                flops += float(entry.get("flops", 0) or 0)
+                bytes_ += float(entry.get("bytes", 0) or 0)
+    except Exception:
+        pass
+    return {"flops_total": flops, "bytes_total": bytes_}
+
+
+def run_trial(spec: Dict[str, Any]) -> Dict[str, Any]:
+    """Measure one knob assignment. NEVER call this from a traced function
+    or a request/fit hot path — it compiles, blocks, and times; the
+    tuner-off-hot-path graftlint rule enforces this."""
+    applied = apply_knobs(spec.get("knobs") or {}, os.environ)
+
+    model = _build_model(spec)
+    x, y = _synth_batch(spec)
+    steps = max(int(spec.get("steps", 16)), 1)
+    warmup = max(int(spec.get("warmup_steps", 2)), 1)
+    batch = (x, y)
+    # warmup mirrors the measured round exactly (same batch list length ⇒
+    # same chain grouping), so every executable the timed round dispatches
+    # is already compiled when the clock starts
+    model.fit([batch] * warmup, epochs=1)
+    t0 = time.perf_counter()
+    model.fit([batch] * steps, epochs=1)
+    dt = time.perf_counter() - t0
+    result = {
+        "ok": True,
+        "steps": steps,
+        "seconds": dt,
+        "steps_per_sec": steps / dt if dt > 0 else 0.0,
+        "knobs": spec.get("knobs") or {},
+        "env": applied,
+        "error": None,
+    }
+    result.update(_cost_totals())
+    return result
+
+
+def main(argv: List[str]) -> int:
+    if len(argv) != 1:
+        print(json.dumps({"ok": False,
+                          "error": "usage: trial <spec.json>"}))
+        return 2
+    # the cost-model objective needs the ledger on, whatever the parent had
+    os.environ.setdefault("DL4J_TPU_OBS", "1")
+    try:
+        with open(argv[0], "r", encoding="utf-8") as f:
+            spec = json.load(f)
+        result = run_trial(spec)
+    except Exception as e:  # a failed candidate is a ranked-last candidate
+        result = {"ok": False, "steps_per_sec": 0.0, "error": repr(e)[:500]}
+    print(json.dumps(result, sort_keys=True))
+    return 0 if result.get("ok") else 1
+
+
+if __name__ == "__main__":  # pragma: no cover - subprocess entry
+    sys.exit(main(sys.argv[1:]))

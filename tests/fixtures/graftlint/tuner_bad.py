@@ -1,0 +1,56 @@
+"""graftlint fixture: tuner-off-hot-path true positives — auto-tuner
+search/trial entry points (compiles + subprocesses + timers) reachable
+from traced / per-batch code. Consulting the DB (tune.maybe_apply) stays
+legal anywhere."""
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import tune
+from deeplearning4j_tpu.tune import search, trial
+
+
+def fwd(params, x):
+    return jnp.dot(x, params)
+
+
+_jit_fwd = jax.jit(fwd)
+
+
+def fit_batch(model, params, x, y):
+    out = _jit_fwd(params, x)
+    best = search.tune_model(model, x, y)       # BAD: full search per batch
+    return out, best
+
+
+def fit_measure(params, x, spec):
+    out = _jit_fwd(params, x)
+    r = trial.run_trial(spec)                   # BAD: compile+measure per batch
+    return out, r
+
+
+def fit_halving(params, x, spec, configs):
+    out = _jit_fwd(params, x)
+    w, _ = search.successive_halving(spec, configs)  # BAD: subprocess fan-out
+    return out, w
+
+
+def step_traced(params, x, spec):
+    def body(p, xx):
+        trial.run_trial(spec)                   # BAD: baked into the trace
+        return jnp.dot(xx, p)
+
+    return jax.jit(body)(params, x)
+
+
+def fit_suppressed(params, x, spec):
+    out = _jit_fwd(params, x)
+    r = trial.run_trial(spec)  # graftlint: disable=tuner-off-hot-path
+    return out, r
+
+
+def fit_ok(model, params, x):
+    # DB lookup + env application is the sanctioned online surface
+    tune.maybe_apply(model, "fit")
+    out = _jit_fwd(params, x)
+    return out

@@ -24,6 +24,7 @@ from deeplearning4j_tpu.train import (
     EarlyStoppingConfiguration,
     EarlyStoppingTrainer,
     InvalidScoreIterationTerminationCondition,
+    MaxParamNormIterationTerminationCondition,
     LocalFileModelSaver,
     MaxEpochsTerminationCondition,
     MaxScoreIterationTerminationCondition,
@@ -212,6 +213,10 @@ class TestEarlyStopping:
             iteration_termination_conditions=[
                 MaxScoreIterationTerminationCondition(1e4),
                 InvalidScoreIterationTerminationCondition(),
+                # the stable log-softmax score cannot overflow (a divergent
+                # step can even land on a perfect separator with score 0.0)
+                # — the PARAMETER norm is what explodes under lr=1e6
+                MaxParamNormIterationTerminationCondition(1e3),
             ],
             score_calculator=DataSetLossCalculator((x, y)),
         )

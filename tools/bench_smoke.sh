@@ -6,7 +6,8 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 out=$(mktemp)
-trap 'rm -f "$out"' EXIT
+out2=$(mktemp)
+trap 'rm -f "$out" "$out2"' EXIT
 BENCH_SMOKE=1 JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python bench.py | tee "$out"
 
 # every registered metric present, none carrying an "error" field, and every
@@ -49,4 +50,29 @@ if not (srv.get("value", 0) > 0
         and over.get("burn_rate", 0) > 0):
     sys.exit(f"bench smoke: serving_slo gates failed: {srv}")
 print(f"bench smoke OK: {len(extras)} metrics, no errors, obs embedded")
+EOF
+
+# auto-tuner gate (docs/TUNING.md): mnist_mlp under DL4J_TPU_TUNE=auto must
+# finish inside the bench budget (rc=124 here is exactly the lenet5 budget
+# regression class) and its tuner arm must hold the >=1.0x-vs-default gate.
+budget=${DL4J_TPU_BENCH_BUDGET_S:-120}
+timeout -k 10 "$((budget * 3 + 300))" env BENCH_SMOKE=1 DL4J_TPU_TUNE=auto \
+    JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python bench.py --only mnist_mlp \
+    | tee "$out2"
+python - "$out2" <<'EOF'
+import json
+import sys
+
+with open(sys.argv[1]) as f:
+    m = json.loads(f.read().strip().splitlines()[-1])
+if m.get("metric") != "mnist_mlp_obs_overhead" or "error" in m:
+    sys.exit(f"bench smoke: tuned mnist_mlp failed: "
+             f"{ {k: v for k, v in m.items() if k != 'obs'} }")
+tuner = m.get("tuner")
+if not isinstance(tuner, dict):
+    sys.exit("bench smoke: mnist_mlp carried no tuner arm")
+if not (tuner.get("skipped") or tuner.get("gate_tuned_ge_default")):
+    sys.exit(f"bench smoke: tuner arm lost to defaults: {tuner}")
+print(f"bench smoke OK: tuned mnist_mlp within budget, tuner arm "
+      f"{'skipped (budget)' if tuner.get('skipped') else 'gate held'}")
 EOF
