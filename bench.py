@@ -817,6 +817,171 @@ def bench_serving_slo():
     }
 
 
+def bench_generate():
+    """Generative-serving bench — the token-level continuous-batching decode
+    engine (serve/scheduler.GenerateWorker) under an OPEN-LOOP load
+    generator: arrivals fire on a fixed schedule regardless of completions,
+    so queueing delay shows up in TTFT instead of being absorbed by a
+    closed loop's back-off.
+
+    Three phases:
+      ramp      arrival-rate sweep (streams/sec); per-level TTFT/ITL
+                quantiles from the SLO tracker's dl4j_ttft_seconds /
+                dl4j_itl_seconds P^2 series and tokens/s from the
+                dl4j_tokens_generated_total counter delta.
+      headline  p99 TTFT (ms) at the highest-tokens/s level.
+      overload  a starved engine (queue_limit=2, decode_batch_max=2) under
+                a deliberately hopeless deadline + arrival blast; gates
+                that the engine SHEDS and the burn-rate gauge reacts.
+
+    Also gates the decode AOT contract: after register_generate's warm,
+    the whole load run must add ZERO compiles at the decode.step site."""
+    import threading
+    from deeplearning4j_tpu.models import TransformerLM
+    from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+    from deeplearning4j_tpu.obs import slo
+    from deeplearning4j_tpu.serve import (
+        GenerateConfig, ModelRegistry, ShedError)
+    from deeplearning4j_tpu.utils import bucketing
+
+    vocab, d_model, n_blocks, max_len = 64, 64, 2, 256
+    rates = [2.0, 6.0, 12.0]        # streams/sec, open-loop
+    window_s = 3.0
+    max_new = 24
+    if SMOKE:
+        d_model, max_len = 32, 64
+        rates = [4.0]
+        window_s = 0.6
+        max_new = 6
+
+    model = MultiLayerNetwork(TransformerLM(
+        vocab_size=vocab, max_len=max_len, d_model=d_model, n_heads=4,
+        n_blocks=n_blocks, dtype="float32"))
+    model.init()
+    tel = bucketing.telemetry()
+    tel.reset()
+
+    cfg = GenerateConfig(decode_batch_max=8, kv_page_tokens=16,
+                         prefill_chunk=16, max_new_default=max_new,
+                         queue_limit=256, default_deadline_s=30.0)
+    reg = ModelRegistry()
+    worker = reg.register_generate("gen", model, warm=True, config=cfg)
+    compiles_warm = tel.compiles("decode.step")
+    tracker = slo.slo_tracker()
+
+    rs = np.random.RandomState(0)
+    prompt_lens = [4, 9, 17, 30]
+    prompts = [rs.randint(0, vocab, size=n).tolist() for n in prompt_lens]
+
+    def open_loop(w, rate, duration, deadline_s=None):
+        """Fire submissions on the arrival clock; each stream is consumed
+        by its own thread (the consumer IS the chunked-HTTP reader)."""
+        stats = {"streams": 0, "tokens": 0, "shed": 0, "shed_mid": 0}
+        lock = threading.Lock()
+        threads = []
+
+        def consume(i):
+            try:
+                s = w.submit(prompts[i % len(prompts)], max_new=max_new,
+                             deadline_s=deadline_s)
+                toks = list(s)
+                with lock:
+                    stats["streams"] += 1
+                    stats["tokens"] += len(toks)
+                    if s.finish_reason == "shed:deadline":
+                        stats["shed_mid"] += 1
+            except ShedError:
+                with lock:
+                    stats["shed"] += 1
+
+        t0 = time.perf_counter()
+        n = int(rate * duration)
+        for i in range(n):
+            wait = t0 + i / rate - time.perf_counter()
+            if wait > 0:
+                time.sleep(wait)
+            t = threading.Thread(target=consume, args=(i,), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=60)
+        stats["dt"] = time.perf_counter() - t0
+        return stats
+
+    def tok_count(route):
+        return int(tracker._tokens.value(route=route) or 0)
+
+    route = "generate.gen"
+    ramp = []
+    try:
+        for rate in rates:
+            tk0 = tok_count(route)
+            st = open_loop(worker, rate, window_s)
+            ttft = tracker._ttft.summary(route=route) or {}
+            itl = tracker._itl.summary(route=route) or {}
+            ramp.append({
+                "arrival_rate": rate,
+                "streams": st["streams"],
+                "tokens_per_s": round((tok_count(route) - tk0) / st["dt"], 1),
+                "ttft_p50_ms": round(ttft.get("p50", 0.0) * 1e3, 3),
+                "ttft_p99_ms": round(ttft.get("p99", 0.0) * 1e3, 3),
+                "itl_p50_ms": round(itl.get("p50", 0.0) * 1e3, 3),
+                "itl_p99_ms": round(itl.get("p99", 0.0) * 1e3, 3),
+                "shed": st["shed"],
+            })
+            if not _budget_left():
+                break
+
+        sat = max(ramp, key=lambda r: r["tokens_per_s"])
+        # the zero-compile gate closes HERE: the overload worker below is
+        # deliberately cold (warm=False) and its compiles are its own
+        request_path_compiles = tel.compiles("decode.step") - compiles_warm
+
+        # Overload arm: starved engine + hopeless deadline; after one
+        # measured stream primes the ITL estimate, repriced admission MUST
+        # shed (arrival or mid-stream) and move the burn-rate gauge.
+        over_cfg = GenerateConfig(decode_batch_max=2, kv_page_tokens=16,
+                                  prefill_chunk=16, max_new_default=max_new,
+                                  queue_limit=2, default_deadline_s=30.0,
+                                  min_samples=1)
+        over = reg.register_generate("gen_over", model, warm=False,
+                                     config=over_cfg)
+        list(over.submit(prompts[0], max_new=max_new))  # prime the ITL model
+        ost = open_loop(over, max(8.0, 4 * sat["arrival_rate"]),
+                        min(window_s, 1.0), deadline_s=0.001)
+        over_route = "generate.gen_over"
+        overload = {
+            "streams": ost["streams"],
+            "shed_arrival": ost["shed"],
+            "shed_midstream": ost["shed_mid"]
+            + over.stats_counters["shed_midstream"],
+            "shed_total": int(tracker._count.value(
+                route=over_route, status="shed") or 0),
+            "burn_rate": tracker.burn_rate(over_route) or 0.0,
+        }
+    finally:
+        reg.shutdown()
+
+    return {
+        "metric": "generate_ttft_p99",
+        "value": sat["ttft_p99_ms"],
+        "unit": "ms",
+        "tokens_per_s": sat["tokens_per_s"],
+        "itl_p99_ms": sat["itl_p99_ms"],
+        "arrival_rate_at_sat": sat["arrival_rate"],
+        "ramp": ramp,
+        "max_occupancy": worker.stats_counters["max_occupancy"],
+        "generated_total": worker.stats_counters["generated"],
+        "compiles_warm": compiles_warm,
+        "request_path_compiles": request_path_compiles,
+        "overload": overload,
+        "note": "open-loop arrivals; TTFT/ITL from dl4j_ttft_seconds / "
+                "dl4j_itl_seconds; overload arm gates shed>0 and burn-rate "
+                "reaction; decode AOT gate: zero decode.step compiles after "
+                "warm",
+    }
+
+
 def _cpu_mesh_env(n: int = 8) -> dict:
     """Env forcing an n-device host-platform mesh (must be set before jax
     initializes) — the dp_comms microbench models an R-replica exchange on
@@ -1312,6 +1477,7 @@ _BENCHES = {
     "transformer": bench_transformer,
     "serving": bench_serving_mixed,
     "serving_slo": bench_serving_slo,
+    "generate": bench_generate,
     "dp_comms": bench_dp_comms,
     "checkpoint": bench_checkpoint,
     "mnist_mlp": bench_mnist_mlp,

@@ -86,6 +86,15 @@ class PositionalEmbedding(LayerConfig):
         T = x.shape[1]
         return x + params["pos"][:T][None, :, :], state
 
+    def decode_apply(self, params, x, positions):
+        """Decode-mode: positions are per-row absolute indices [B, Tc]
+        (a chunk mid-stream starts wherever the row's cache ends), not the
+        implicit 0..T-1 of the training path. Clipped, not wrapped: padded
+        chunk slots may carry positions past the table; their activations
+        are dead (masked by the caller's n_new) either way."""
+        idx = jnp.clip(positions, 0, params["pos"].shape[0] - 1)
+        return x + jnp.take(params["pos"], idx, axis=0)
+
 
 @register_layer("multi_head_attention")
 @dataclass
@@ -193,6 +202,27 @@ class MultiHeadAttention(LayerConfig):
             out = jnp.where(jax.random.bernoulli(rng_attn, keep, out.shape), out / keep, 0.0)
         return out @ params["Wo"] + params["bo"], state
 
+    def decode_apply(self, params, x, *, cache, positions):
+        """Single-query/chunk attention against a KV cache (serving decode
+        path, nn/decode.py). ``x`` [B, Tc, C] is the new-token chunk;
+        ``cache`` is a cache view (append + gathered, paged or contiguous —
+        the layer never sees the paging); ``positions`` [B, Tc] are the
+        chunk's absolute positions. Eval-mode by construction: no dropout,
+        no rng. The chunk's own k/v are appended to the cache BEFORE the
+        gather, so causal self-attention within the chunk and attention
+        over the history are one masked span (ops.decode_attention)."""
+        from deeplearning4j_tpu.ops.flash_attention import decode_attention
+
+        B, Tc, C = x.shape
+        H = self.n_heads
+        qkv = x @ params["Wqkv"] + params["bqkv"]
+        q, k, v = jnp.split(qkv.reshape(B, Tc, 3 * H, C // H), 3, axis=2)
+        cache.append(k, v)
+        k_all, v_all = cache.gathered()
+        out = decode_attention(q, k_all, v_all, positions)   # [B,Tc,H,D]
+        out = out.reshape(B, Tc, C)
+        return out @ params["Wo"] + params["bo"]
+
 
 @register_layer("transformer_block")
 @dataclass
@@ -262,6 +292,19 @@ class TransformerBlock(LayerConfig):
         x = self.maybe_dropout_input(x, train, rng_in)
         h = self._ln(params["ln1"], x)
         a, _ = self._mha().apply(params["attn"], {}, h, train=train, rng=rng_attn, mask=mask)
+        x = x + a
+        h = self._ln(params["ln2"], x)
+        h = self.activation_fn()(h @ params["Wi"] + params["bi"])
+        return x + (h @ params["Wo"] + params["bo"])
+
+    def decode_apply(self, params, x, *, cache, positions):
+        """The block's eval-mode forward for a new-token chunk against a KV
+        cache: identical composition to :meth:`_apply_inner` with the MHA
+        swapped for its cache-backed decode path (see
+        MultiHeadAttention.decode_apply)."""
+        h = self._ln(params["ln1"], x)
+        a = self._mha().decode_apply(params["attn"], h, cache=cache,
+                                     positions=positions)
         x = x + a
         h = self._ln(params["ln2"], x)
         h = self.activation_fn()(h @ params["Wi"] + params["bi"])

@@ -44,7 +44,8 @@ from typing import Deque, Dict, Optional, Tuple
 
 from deeplearning4j_tpu.obs import metrics
 
-__all__ = ["SloTracker", "slo_tracker", "observe_request", "observe_shed"]
+__all__ = ["SloTracker", "slo_tracker", "observe_request", "observe_shed",
+           "observe_ttft", "observe_itl", "set_decode_occupancy"]
 
 
 class SloTracker:
@@ -80,6 +81,32 @@ class SloTracker:
             "dl4j_shed_total",
             "load-shedding decisions by route and reason (backpressure -> "
             "429, deadline -> 503)", ("route", "reason"))
+        # token-level generative serving (serve/scheduler.GenerateWorker):
+        # a stream's user experience is TTFT + the ITL tail, not one
+        # end-to-end latency, so both get their own histograms and their
+        # own thresholds into the SAME burn-rate window — a slow first
+        # token or a stuttering stream spends error budget exactly like a
+        # slow predict() request
+        self.ttft_threshold_s = float(
+            env("DL4J_TPU_SLO_TTFT_MS",
+                env("DL4J_TPU_SLO_LATENCY_MS", "250"))) / 1e3
+        self.itl_threshold_s = float(env("DL4J_TPU_SLO_ITL_MS", "100")) / 1e3
+        self._ttft = self._reg.histogram(
+            "dl4j_ttft_seconds",
+            "time to first generated token by route (prompt queue + prefill; "
+            "P2 streaming quantiles)", ("route",))
+        self._itl = self._reg.histogram(
+            "dl4j_itl_seconds",
+            "inter-token latency by route (decode-step cadence as the "
+            "stream consumer sees it)", ("route",))
+        self._tokens = self._reg.counter(
+            "dl4j_tokens_generated_total",
+            "generated tokens by route (every emitted decode token)",
+            ("route",))
+        self._occupancy = self._reg.gauge(
+            "dl4j_decode_batch_occupancy",
+            "streams currently in the token-level continuous decode batch",
+            ("model",))
         self._lock = threading.Lock()
         # route -> deque[(perf_counter_ts, is_bad)]
         self._windows: Dict[str, Deque[Tuple[float, bool]]] = {}
@@ -123,6 +150,36 @@ class SloTracker:
             rate = (n_bad / len(win)) / (1.0 - self.objective)
         self._burn.set(round(rate, 4), route=route)
 
+    def observe_ttft(self, route: str, latency_s: float):
+        """Record one stream's time-to-first-token. Counts the first token
+        into the token counter and burns budget when it misses the TTFT
+        threshold. Never raises."""
+        try:
+            self._ttft.observe(latency_s, route=route)
+            self._tokens.inc(route=route)
+            self._note_window(route, latency_s > self.ttft_threshold_s)
+        except Exception:
+            pass
+
+    def observe_itl(self, route: str, latency_s: float):
+        """Record one inter-token gap; every call is one more generated
+        token. A gap over the ITL threshold burns budget — stream stutter
+        is an SLO violation even when the total finishes on time. Never
+        raises."""
+        try:
+            self._itl.observe(latency_s, route=route)
+            self._tokens.inc(route=route)
+            self._note_window(route, latency_s > self.itl_threshold_s)
+        except Exception:
+            pass
+
+    def set_decode_occupancy(self, model: str, streams: int):
+        """Gauge: streams currently holding a decode-batch slot."""
+        try:
+            self._occupancy.set(int(streams), model=model)
+        except Exception:
+            pass
+
     def burn_rate(self, route: str) -> Optional[float]:
         return self._burn.value(route=route)
 
@@ -161,6 +218,30 @@ def observe_shed(route: str, reason: str = "backpressure"):
 
     if obs.enabled():
         slo_tracker().observe_shed(route, reason=reason)
+
+
+def observe_ttft(route: str, latency_s: float):
+    """Module-level convenience; honors the DL4J_TPU_OBS kill switch."""
+    from deeplearning4j_tpu import obs
+
+    if obs.enabled():
+        slo_tracker().observe_ttft(route, latency_s)
+
+
+def observe_itl(route: str, latency_s: float):
+    """Module-level convenience; honors the DL4J_TPU_OBS kill switch."""
+    from deeplearning4j_tpu import obs
+
+    if obs.enabled():
+        slo_tracker().observe_itl(route, latency_s)
+
+
+def set_decode_occupancy(model: str, streams: int):
+    """Module-level convenience; honors the DL4J_TPU_OBS kill switch."""
+    from deeplearning4j_tpu import obs
+
+    if obs.enabled():
+        slo_tracker().set_decode_occupancy(model, streams)
 
 
 def _reset_tracker():

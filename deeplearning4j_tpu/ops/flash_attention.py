@@ -681,3 +681,50 @@ def merge_attention_blocks(parts):
 # ring's per-shard blocks land back under the ceiling. A second grid axis
 # could lift this limit in-kernel; not needed at the lengths the framework
 # targets single-chip.
+
+
+# ---------------------------------------------------------------------------
+# Decode-mode attention (KV-cache serving path, nn/decode.py)
+# ---------------------------------------------------------------------------
+
+
+def decode_attention(q, k, v, q_positions):
+    """Attention of a short new-token chunk against a gathered KV cache.
+
+    ``q`` [B, Tc, H, D] — the chunk being decoded/prefilled (Tc is 1 in
+    steady-state decode, a prefill-chunk bucket otherwise); ``k``/``v``
+    [B, K, H, D] — the cache span gathered for each row, laid out so index
+    ``g`` along K IS absolute sequence position ``g`` (nn/decode.py writes
+    the chunk's own k/v into the cache before gathering, so no separate
+    self-attention term exists); ``q_positions`` [B, Tc] int32 — each
+    query's absolute position. Causality is positional: key ``g`` is valid
+    iff ``g <= q_positions[b, t]``, which simultaneously enforces the
+    causal mask and hides every cache slot past the row's written length
+    (unwritten pool pages hold finite garbage, masked to an exact-zero
+    softmax weight).
+
+    Deliberately plain XLA, not Pallas: flash attention exists to keep the
+    [T, T] score tensor out of HBM, but here the score tensor is
+    [B, H, Tc, K] with Tc <= one prefill chunk — a few hundred KB at
+    serving shapes. The flash kernel remains the training/full-prefill
+    path. Numerics mirror ``parallel/ring.py local_attention`` (scores in
+    the operand dtype, -inf mask clamped at ``_NEG_BIG``) so a
+    cache-backed prefill agrees with the full forward on the XLA path.
+
+    Bit-exactness under padding (the serving tier's batched==unbatched
+    guarantee): padded batch rows are independent (row-block computation),
+    and padded/masked cache tail positions contribute exp(-1e30 - m) = 0
+    exactly to the softmax and 0 * v to the value sum — trailing zero
+    terms that leave every real row's reduction bitwise unchanged.
+    """
+    K = k.shape[1]
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale      # [B, H, Tc, K]
+    valid = jnp.arange(K)[None, None, None, :] <= \
+        q_positions[:, None, :, None]                    # [B, 1, Tc, K]
+    s = jnp.where(valid, s, -jnp.inf)
+    # position 0 is always <= q_position, so no row is fully masked; the
+    # clamp keeps the same guard local_attention carries regardless
+    s = jnp.maximum(s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)           # [B, Tc, H, D]

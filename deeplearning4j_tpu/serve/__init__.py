@@ -19,24 +19,39 @@ Quick start::
 
 or from a shell: ``python -m deeplearning4j_tpu.serve mnist=model.h5``.
 
+Token-level generative serving rides the same tier:
+``registry.register_generate(name, model)`` AOT-warms the bucketed
+KV-cache decode engine (nn/decode.py) behind a
+:class:`~.scheduler.GenerateWorker`, streamed over HTTP as
+``POST /v1/models/<name>:generate`` (chunked NDJSON).
+
 Knobs: ``DL4J_TPU_SERVE_MAX_BATCH``, ``DL4J_TPU_SERVE_QUEUE``,
 ``DL4J_TPU_SERVE_MARGIN_MS``, ``DL4J_TPU_SERVE_WAIT_MS``,
 ``DL4J_TPU_SERVE_WAIT_QUANTUM_MS``, ``DL4J_TPU_SERVE_DEFAULT_DEADLINE_MS``,
-``DL4J_TPU_SERVE_MIN_SAMPLES``, ``DL4J_TPU_SERVE_WORKERS`` — docs/SERVING.md.
+``DL4J_TPU_SERVE_MIN_SAMPLES``, ``DL4J_TPU_SERVE_WORKERS``; generation adds
+``DL4J_TPU_DECODE_BATCH_MAX``, ``DL4J_TPU_KV_PAGE_TOKENS``,
+``DL4J_TPU_KV_PAGED``, ``DL4J_TPU_PREFILL_CHUNK``, ``DL4J_TPU_GEN_MAX_NEW``,
+``DL4J_TPU_GEN_QUEUE``, ``DL4J_TPU_GEN_DEADLINE_MS`` — docs/SERVING.md.
 """
 
 from deeplearning4j_tpu.serve.admission import (
-    AdmissionController, LatencyModel, ServeConfig)
+    AdmissionController, GenerateConfig, LatencyModel, ServeConfig,
+    TokenAdmission)
 from deeplearning4j_tpu.serve.registry import ModelRegistry
-from deeplearning4j_tpu.serve.scheduler import ModelWorker, ShedError
+from deeplearning4j_tpu.serve.scheduler import (
+    GenerateStream, GenerateWorker, ModelWorker, ShedError)
 from deeplearning4j_tpu.serve.server import InferenceServer
 
 __all__ = [
     "AdmissionController",
+    "GenerateConfig",
+    "GenerateStream",
+    "GenerateWorker",
     "InferenceServer",
     "LatencyModel",
     "ModelRegistry",
     "ModelWorker",
     "ServeConfig",
     "ShedError",
+    "TokenAdmission",
 ]

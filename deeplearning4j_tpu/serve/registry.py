@@ -33,8 +33,9 @@ import time
 from typing import Dict, List, Optional
 
 from deeplearning4j_tpu import obs
-from deeplearning4j_tpu.serve.admission import LatencyModel, ServeConfig
-from deeplearning4j_tpu.serve.scheduler import ModelWorker
+from deeplearning4j_tpu.serve.admission import (
+    GenerateConfig, LatencyModel, ServeConfig)
+from deeplearning4j_tpu.serve.scheduler import GenerateWorker, ModelWorker
 
 __all__ = ["ModelRegistry"]
 
@@ -45,6 +46,7 @@ class ModelRegistry:
         self.latency = LatencyModel(min_samples=self.config.min_samples)
         self._lock = threading.Lock()
         self._workers: Dict[str, ModelWorker] = {}
+        self._generators: Dict[str, GenerateWorker] = {}
         self._meta: Dict[str, Dict[str, object]] = {}
 
     # -- intake ------------------------------------------------------------
@@ -65,6 +67,72 @@ class ModelRegistry:
         obs.event("serve_model_loaded", model=name, **{
             k: meta[k] for k in ("source", "model_class", "warmed", "restored",
                                  "warm_seconds")})
+        return worker
+
+    def register_generate(self, name: str, model, warm: bool = True,
+                          bundle: Optional[str] = None,
+                          config: Optional[GenerateConfig] = None,
+                          capacity: Optional[int] = None) -> GenerateWorker:
+        """Put an autoregressive LM behind a token-level continuous-batching
+        decode engine under ``name`` (``/v1/generate``).
+
+        Same lifecycle as :meth:`register` but for the DECODE executable
+        set: tuner selections land first (``kv_page_tokens`` /
+        ``decode_batch_max`` are scope=serve knobs, so ``GenerateConfig``
+        is read AFTER ``tune.maybe_apply``), then the
+        :class:`~deeplearning4j_tpu.nn.decode.DecodeProgram`'s jitted step
+        registers on the model's AOT site table — a ``bundle`` restore
+        installs its serialized executables BEFORE ``warm`` enumerates the
+        (batch x chunk x table) bucket grid, and the now-warm set persists
+        back to the bundle, so a cold process streams tokens with zero
+        request-path compiles."""
+        import os as _os
+
+        from deeplearning4j_tpu.nn import aot
+        from deeplearning4j_tpu.nn.decode import DecodeProgram
+
+        if getattr(model, "params", None) is None:
+            model.init()
+        if _os.environ.get("DL4J_TPU_TUNE"):
+            from deeplearning4j_tpu import tune as _tune
+
+            _tune.maybe_apply(model, "serve")
+        cfg = config or GenerateConfig.from_env()
+        program = DecodeProgram(
+            model, page_tokens=cfg.kv_page_tokens,
+            max_batch=cfg.decode_batch_max, prefill_chunk=cfg.prefill_chunk,
+            paged=cfg.paged, capacity=capacity)
+        restored = 0
+        if bundle:
+            restored = aot.restore_bundle(model, bundle)
+        warmed = 0
+        warm_dt = 0.0
+        if warm:
+            t0 = time.perf_counter()
+            warmed = program.warm()
+            warm_dt = time.perf_counter() - t0
+            if bundle:
+                aot.save_bundle(model, bundle)
+        worker = GenerateWorker(name, model, program, config=cfg,
+                                latency=self.latency)
+        meta = {
+            "source": "object",
+            "model_class": type(model).__name__,
+            "warmed": int(warmed),
+            "restored": int(restored),
+            "warm_seconds": round(warm_dt, 4),
+            "bundle": bundle,
+            "generate": True,
+        }
+        with self._lock:
+            old = self._generators.pop(name, None)
+            self._generators[name] = worker
+            self._meta[f"generate:{name}"] = meta
+        if old is not None:
+            old.shutdown()
+        obs.event("serve_model_loaded", model=name, mode="generate", **{
+            k: meta[k] for k in ("source", "model_class", "warmed",
+                                 "restored", "warm_seconds")})
         return worker
 
     def load(self, name: str, path: str, warm: bool = True,
@@ -120,15 +188,22 @@ class ModelRegistry:
         with self._lock:
             return self._workers.get(name)
 
+    def generator(self, name: str) -> Optional[GenerateWorker]:
+        with self._lock:
+            return self._generators.get(name)
+
     def names(self) -> List[str]:
         with self._lock:
-            return sorted(self._workers)
+            return sorted(set(self._workers) | set(self._generators))
 
     def describe(self) -> List[Dict[str, object]]:
         """One JSON-friendly row per served model (GET /v1/models)."""
         with self._lock:
             pairs = [(self._workers[n], dict(self._meta.get(n, {})))
                      for n in sorted(self._workers)]
+            pairs += [(self._generators[n],
+                       dict(self._meta.get(f"generate:{n}", {})))
+                      for n in sorted(self._generators)]
         rows = []
         for worker, meta in pairs:
             row = worker.stats()
@@ -138,8 +213,10 @@ class ModelRegistry:
 
     def shutdown(self):
         with self._lock:
-            workers = list(self._workers.values())
+            workers = (list(self._workers.values())
+                       + list(self._generators.values()))
             self._workers.clear()
+            self._generators.clear()
             self._meta.clear()
         for w in workers:
             w.shutdown()

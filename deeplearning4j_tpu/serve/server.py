@@ -10,6 +10,17 @@ is exactly what the coalescing scheduler wants: concurrent blocked
   payload, 404 unknown model, **429** shed by queue backpressure (with
   ``Retry-After``), **503** shed because the deadline is infeasible or
   already expired;
+- ``POST /v1/models/<name>:generate`` with ``{"prompt": [token ids],
+  "max_tokens": 32, "deadline_ms": 30000, "eos": 2}`` → a CHUNKED
+  (HTTP/1.1 ``Transfer-Encoding: chunked``) ``application/x-ndjson``
+  stream: one ``{"token": id, "i": n}`` line per generated token, flushed
+  the moment the decode engine emits it (token-level streaming — TTFT is
+  prefill latency, not whole-response latency), then a terminal
+  ``{"done": true, "reason": ..., "tokens": n, "ttft_ms": ...}`` line.
+  Arrival-time sheds keep the predict() status semantics (429/503) since
+  no bytes have streamed yet; a MID-STREAM shed (deadline repriced per
+  remaining token budget) arrives as the terminal line's
+  ``reason == "shed:deadline"`` — the status line already said 200;
 - ``GET /v1/models`` → per-model pool stats (queue depth, batches, warm
   metadata);
 - ``GET /healthz``, ``GET /metrics`` — from serve/httpcommon.py; /metrics
@@ -28,6 +39,7 @@ answers its port never compiles on the request path.
 
 from __future__ import annotations
 
+import json
 import re
 from typing import Optional
 from urllib.parse import urlparse
@@ -43,6 +55,7 @@ from deeplearning4j_tpu.serve.scheduler import ShedError
 __all__ = ["InferenceServer"]
 
 _PREDICT_RE = re.compile(r"^/v1/models/([\w.\-]+):predict$")
+_GENERATE_RE = re.compile(r"^/v1/models/([\w.\-]+):generate$")
 
 
 class InferenceServer:
@@ -63,10 +76,16 @@ class InferenceServer:
 
         class Handler(httpcommon.ObservedHandler):
             inflight = outer._inflight
+            # chunked transfer encoding (the streaming generate route) is
+            # an HTTP/1.1 feature; Content-Length replies are unaffected
+            protocol_version = "HTTP/1.1"
 
             def slo_route(self, path: str) -> str:
                 m = _PREDICT_RE.match(path)
-                return f"serve.{m.group(1)}:http" if m else path
+                if m:
+                    return f"serve.{m.group(1)}:http"
+                m = _GENERATE_RE.match(path)
+                return f"generate.{m.group(1)}:http" if m else path
 
             def handle_get(self) -> int:
                 if urlparse(self.path).path == "/v1/models":
@@ -76,7 +95,70 @@ class InferenceServer:
                 self.end_headers()
                 return 404
 
+            # -- streaming generate ----------------------------------------
+
+            def _chunk(self, data: bytes) -> None:
+                self.wfile.write(f"{len(data):x}\r\n".encode())
+                self.wfile.write(data)
+                self.wfile.write(b"\r\n")
+
+            def handle_generate(self, name: str) -> int:
+                gen = outer.registry.generator(name)
+                if gen is None:
+                    return self.send_json(
+                        404, {"error": f"model {name!r} not served for "
+                              f"generation", "served": outer.registry.names()})
+                try:
+                    payload = self.read_json()
+                    prompt = [int(t) for t in payload["prompt"]]
+                    max_new = payload.get("max_tokens")
+                    eos = payload.get("eos")
+                    eos = None if eos is None else int(eos)
+                    deadline_ms = payload.get("deadline_ms")
+                    deadline_s = (None if deadline_ms is None
+                                  else float(deadline_ms) / 1e3)
+                    if deadline_s is not None and deadline_s <= 0:
+                        raise ValueError("deadline_ms must be > 0")
+                except Exception as e:
+                    return self.send_json(400, {"error": str(e)})
+                try:
+                    stream = gen.submit(prompt, max_new=max_new, eos=eos,
+                                        deadline_s=deadline_s)
+                except ShedError as e:
+                    body = {"error": str(e), "shed": e.reason}
+                    if e.http_status == 429:
+                        return self.send_json(429, body,
+                                              headers=(("Retry-After", "1"),))
+                    return self.send_json(503, body)
+                except ValueError as e:
+                    return self.send_json(400, {"error": str(e)})
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    for i, tok in enumerate(stream):
+                        self._chunk(json.dumps(
+                            {"token": int(tok), "i": i}).encode() + b"\n")
+                        self.wfile.flush()
+                    tail = {"done": True, "reason": stream.finish_reason,
+                            "tokens": len(stream.tokens)}
+                    if stream.ttft_s is not None:
+                        tail["ttft_ms"] = round(stream.ttft_s * 1e3, 3)
+                except Exception as e:
+                    tail = {"done": True, "reason": "error", "error": str(e)}
+                try:
+                    self._chunk(json.dumps(tail).encode() + b"\n")
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # client went away mid-stream; engine already done
+                return 200
+
             def handle_post(self) -> int:
+                g = _GENERATE_RE.match(urlparse(self.path).path)
+                if g:
+                    return self.handle_generate(g.group(1))
                 m = _PREDICT_RE.match(urlparse(self.path).path)
                 if not m:
                     return self.send_json(404, {"error": "no such route"})

@@ -139,6 +139,20 @@ KNOBS: Tuple[Knob, ...] = (
         help="gradient-accumulation micro-batches per optimizer step "
              "(lax.scan inside the donated step; 1/A activation footprint)",
     ),
+    Knob(
+        name="kv_page_tokens", env="DL4J_TPU_KV_PAGE_TOKENS", kind="int",
+        domain=(16, 32, 64, 128), default=64, scope="serve",
+        help="KV-cache page size in tokens (decode engine, nn/decode.py): "
+             "small pages waste less cache on short streams, large pages "
+             "gather fewer indices per decode step",
+    ),
+    Knob(
+        name="decode_batch_max", env="DL4J_TPU_DECODE_BATCH_MAX", kind="int",
+        domain=(4, 8, 16, 32), default=8, scope="serve",
+        help="token-level continuous-batching width cap: tokens/s rises "
+             "with width until the padded decode step's ITL breaks the "
+             "stream SLO",
+    ),
 )
 
 _BY_NAME: Dict[str, Knob] = {k.name: k for k in KNOBS}

@@ -49,6 +49,19 @@ if not (srv.get("value", 0) > 0
         and over.get("shed_total", 0) > 0
         and over.get("burn_rate", 0) > 0):
     sys.exit(f"bench smoke: serving_slo gates failed: {srv}")
+# generative-serving acceptance gates (docs/SERVING.md decode section): a
+# p99 TTFT under open-loop load, zero decode.step compiles after the
+# registry's decode warm, tokens actually streamed, and a forced overload
+# that SHEDS with the burn-rate gauge reacting
+gen = next(m for m in extras if m["metric"] == "generate_ttft_p99")
+gover = gen.get("overload", {})
+if not (gen.get("value", 0) > 0
+        and gen.get("request_path_compiles") == 0
+        and gen.get("generated_total", 0) > 0
+        and gover.get("shed_total", 0) > 0
+        and gover.get("burn_rate", 0) > 0):
+    sys.exit(f"bench smoke: generate gates failed: "
+             f"{ {k: v for k, v in gen.items() if k != 'obs'} }")
 print(f"bench smoke OK: {len(extras)} metrics, no errors, obs embedded")
 EOF
 

@@ -9,6 +9,11 @@
 #      compiles, answers bit-exactly whether requests are coalesced or
 #      served one at a time, and under forced overload SHEDS (429/503 +
 #      dl4j_shed_total) instead of queueing without bound.
+# The same two phases also carry the GENERATIVE tier: phase 1 warms the
+# bucketed KV-cache decode engine (decode.step executable set) for a
+# TransformerLM and persists its bundle; phase 2 cold-restores it and
+# streams a chunked /v1/models/<name>:generate round trip that must emit
+# the SAME tokens with ZERO decode.step compiles.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -31,6 +36,23 @@ FIXTURE = "tests/fixtures/keras_cnn.h5"
 MAX_BATCH = 8
 bundle = sys.argv[1]
 x = np.load("tests/fixtures/keras_cnn_io.npz")["x"].astype(np.float32)
+
+# generative tier: conf.seed makes init() deterministic, so the cold
+# process rebuilds bit-identical weights and the token stream must match
+from deeplearning4j_tpu.models import TransformerLM
+from deeplearning4j_tpu.nn.model import MultiLayerNetwork
+from deeplearning4j_tpu.serve import GenerateConfig
+
+def lm_model():
+    return MultiLayerNetwork(TransformerLM(
+        vocab_size=32, max_len=64, d_model=32, n_heads=4, n_blocks=2,
+        dtype="float32")).init()
+
+GEN_CFG = GenerateConfig(decode_batch_max=4, kv_page_tokens=8,
+                         prefill_chunk=16, max_new_default=8, queue_limit=8)
+LM_PROMPT = [3, 1, 4, 1, 5, 9, 2, 6]
+lm_bundle = os.path.join(os.path.dirname(bundle), "lm.aotbundle")
+lm_tokens_ref = os.path.join(os.path.dirname(bundle), "lm_tokens.json")
 EOF
 )
 
@@ -44,9 +66,21 @@ assert meta["warmed"] > 0, meta
 assert os.path.exists(bundle), "bundle not persisted"
 ref = np.asarray(w.submit(x))
 np.save(os.path.join(os.path.dirname(bundle), "reference.npy"), ref)
+
+# generative tier: warm the decode executable set, persist, stream once
+gw = reg.register_generate("lm", lm_model(), bundle=lm_bundle,
+                           config=GEN_CFG)
+gmeta = [m for m in reg.describe() if m.get("generate")][0]
+assert gmeta["warmed"] > 0, gmeta
+assert os.path.exists(lm_bundle), "decode bundle not persisted"
+toks = list(gw.submit(LM_PROMPT, max_new=6))
+assert len(toks) == 6, toks
+with open(lm_tokens_ref, "w") as f:
+    json.dump(toks, f)
 reg.shutdown()
-print(f"warmed {meta['warmed']} executables in {meta['warm_seconds']}s, "
-      f"bundle {os.path.getsize(bundle)} bytes")
+print(f"warmed {meta['warmed']} predict + {gmeta['warmed']} decode "
+      f"executables; bundles {os.path.getsize(bundle)} + "
+      f"{os.path.getsize(lm_bundle)} bytes")
 EOF
 
 echo "== phase 2: COLD process restores, serves, sheds under overload =="
@@ -120,10 +154,38 @@ assert shed[0] > 0 and shed_total and shed_total > 0, \
     f"forced overload did not shed (client={shed[0]}, metric={shed_total})"
 assert burn and burn > 0, f"burn-rate gauge did not react: {burn}"
 
+# -- generative tier: cold restore -> streaming generate, zero compiles --
+gw = reg.register_generate("lm", lm_model(), bundle=lm_bundle,
+                           config=GEN_CFG)
+gmeta = [m for m in reg.describe() if m.get("generate")][0]
+assert gmeta["restored"] > 0, f"cold decode restore installed nothing: {gmeta}"
+gen_compiles_warm = tel.compiles("decode.step")
+
+import http.client
+conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=30)
+body = json.dumps({"prompt": LM_PROMPT, "max_tokens": 6}).encode()
+conn.request("POST", "/v1/models/lm:generate", body,
+             {"Content-Type": "application/json"})
+resp = conn.getresponse()
+assert resp.status == 200, resp.status
+assert resp.getheader("Transfer-Encoding") == "chunked", \
+    "generate response is not streamed"
+lines = [json.loads(l) for l in resp.read().decode().strip().splitlines()]
+assert lines[-1]["done"] and lines[-1]["reason"] == "length", lines[-1]
+toks = [l["token"] for l in lines[:-1]]
+with open(lm_tokens_ref) as f:
+    want = json.load(f)
+assert toks == want, f"cold-restore stream {toks} != warm process {want}"
+gen_compiles = tel.compiles("decode.step") - gen_compiles_warm
+assert gen_compiles == 0, \
+    f"decode path compiled {gen_compiles}x after cold restore"
+
 srv.stop()
-print(f"restored {meta['restored']} executables; {len(x)} coalesced HTTP "
-      f"requests bit-exact vs solo and warm process; 0 request-path "
-      f"compiles; overload shed {shed_total} (burn rate {burn})")
+print(f"restored {meta['restored']} predict + {gmeta['restored']} decode "
+      f"executables; {len(x)} coalesced HTTP requests bit-exact vs solo "
+      f"and warm process; streaming generate bit-exact vs warm process; "
+      f"0 request-path compiles (predict AND decode); overload shed "
+      f"{shed_total} (burn rate {burn})")
 EOF
 
 echo "serve smoke OK"
