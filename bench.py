@@ -1087,6 +1087,125 @@ def bench_dp_comms():
     }
 
 
+def bench_mesh_mfu():
+    """MULTICHIP promoted (ISSUE 13) — the ONE mesh step program across
+    (data, tensor, stage) shapes on an R-device mesh. Each arm trains the
+    same MLP from the same seed on the same batch through
+    parallel/mesh_step.MeshTrainer: params per the TP rules, optimizer
+    moments sharded over the spare axes (arXiv 2004.13336), the gradient
+    all-reduce rewritten per shape by GSPMD.
+
+    Gates (tools/bench_smoke.sh):
+      gate_tuned_ge_dp_baseline        the best measured shape >= the
+                                       pure-DP (d=R,t=1,s=1) default —
+                                       holds by construction (the default
+                                       is in the race), which is the same
+                                       contract the knob registry gives
+                                       every tuned default
+      gate_shape_parity                fixed-step losses match across every
+                                       shape (same math, different layout)
+      gate_zero_steady_state_compiles  no mln.step re-traces inside any
+                                       arm's measured loop (the output
+                                       sharding constraints pin the layout)
+
+    dl4j_mfu per shape lands when the backend has a roofline (TPU); on the
+    CPU smoke mesh the throughput ratios carry the gates and MFU is omitted
+    rather than fabricated."""
+    import jax
+
+    from deeplearning4j_tpu.nn.input_type import InputType
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.model import (
+        MultiLayerConfiguration, MultiLayerNetwork)
+    from deeplearning4j_tpu.parallel import MeshSpec, MeshTrainer
+    from deeplearning4j_tpu.utils import bucketing
+
+    R = min(8, jax.device_count())
+    n_feat, hidden, classes = 64, (32 if SMOKE else 512), 10
+    batch = 8 * R
+    shapes = [(R, 1, 1)]
+    if R >= 2 and R % 2 == 0:
+        shapes += [(R // 2, 2, 1), (R // 2, 1, 2)]
+    if R >= 4 and R % 4 == 0:
+        shapes.append((R // 4, 2, 2))
+
+    def build():
+        conf = MultiLayerConfiguration(
+            layers=(Dense(n_out=hidden, activation="tanh"),
+                    OutputLayer(n_out=classes, activation="softmax")),
+            input_type=InputType.feed_forward(n_feat),
+            updater={"type": "adam", "lr": 0.01},
+            seed=7,
+        )
+        return MultiLayerNetwork(conf).init()
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(batch, n_feat).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rs.randint(0, classes, batch)]
+
+    peak = _peak_flops("bfloat16")
+    # analytic train FLOPs (2*MACs forward, x3 fwd+bwd), GLOBAL per step —
+    # layout-independent, so cross-shape MFU compares pure efficiency
+    train_flops = 3.0 * 2.0 * batch * (n_feat * hidden + hidden * classes)
+
+    tel = bucketing.telemetry()
+    arms, mfu, probes, retraces = {}, {}, {}, {}
+    for d, t, s in shapes:
+        key = f"d{d}t{t}s{s}"
+        trainer = MeshTrainer(build(), MeshSpec(data=d, model=t, pipe=s))
+        # fixed-step parity probe (compiles land here, outside the timing)
+        probes[key] = [round(float(trainer.fit_batch(x, y)), 6)
+                       for _ in range(3)]
+        traced = tel.traces.get("mln.step", 0)
+
+        def run(n, fit=trainer.fit_batch):
+            loss = None
+            for _ in range(n):
+                loss = fit(x, y)
+            float(loss)  # value fetch: the only sync the tunnel cannot elide
+
+        dt, n_done = _timed(run, warmup_steps=1, steps=2 if SMOKE else 20)
+        retraces[key] = tel.traces.get("mln.step", 0) - traced
+        sps = n_done * batch / dt
+        arms[key] = round(sps, 1)
+        if peak:
+            mfu[key] = round(train_flops * (sps / batch) / (peak * R), 4)
+        trainer.finish()
+
+    base_key = f"d{R}t1s1"
+    best_key = max(arms, key=arms.get)
+    base = np.asarray(probes[base_key])
+    dev = max(float(np.max(np.abs(np.asarray(p) - base)
+                           / np.maximum(np.abs(base), 1e-9)))
+              for p in probes.values())
+    out = {
+        "metric": "mesh_step_tuned_vs_dp",
+        "value": round(arms[best_key] / max(arms[base_key], 1e-9), 3),
+        "unit": "x samples/sec, best (d,t,s) over pure-DP (d=R,t=1,s=1)",
+        "devices": R,
+        "tuned_shape": best_key,
+        "arms_samples_per_sec": arms,
+        "shape_losses": probes,
+        "parity_max_rel_dev": round(dev, 8),
+        "steady_state_retraces": retraces,
+        "gate_tuned_ge_dp_baseline": arms[best_key] >= arms[base_key],
+        "gate_shape_parity": dev < 1e-3,
+        "gate_zero_steady_state_compiles": all(
+            v == 0 for v in retraces.values()),
+    }
+    if mfu:
+        out["dl4j_mfu"] = mfu
+        # land the per-shape MFU in the live gauge the cost layer owns
+        from deeplearning4j_tpu.obs import metrics as obs_metrics
+
+        g = obs_metrics.registry().gauge(
+            "dl4j_mfu", "model FLOPs utilization: achieved flops/s at the "
+            "site's step span over the bf16 roofline", ("site",))
+        for k, v in mfu.items():
+            g.set(v, site=f"mesh.step.{k}")
+    return out
+
+
 def bench_checkpoint():
     """Durable-checkpoint cycle (docs/ROBUSTNESS.md): atomic full-state save
     (tmp+fsync+rename, CRC over the final bytes) -> CRC validation ->
@@ -1479,6 +1598,7 @@ _BENCHES = {
     "serving_slo": bench_serving_slo,
     "generate": bench_generate,
     "dp_comms": bench_dp_comms,
+    "mesh_mfu": bench_mesh_mfu,
     "checkpoint": bench_checkpoint,
     "mnist_mlp": bench_mnist_mlp,
     "cold_start": bench_cold_start,
@@ -1486,7 +1606,7 @@ _BENCHES = {
 
 # benches that need a multi-device mesh regardless of the host's accelerator
 # count — run on forced virtual CPU devices in their isolated subprocess
-_CPU_MESH_BENCHES = {"dp_comms"}
+_CPU_MESH_BENCHES = {"dp_comms", "mesh_mfu"}
 
 
 def _run_isolated(name: str) -> dict:

@@ -10,6 +10,7 @@
 | monotonic-clock   | everything            | wall clock in duration arithmetic    |
 | cost-analysis-off-hot-path | traced + hot | HLO cost walk / trace export per batch |
 | tuner-off-hot-path | traced + hot         | tuner search/trial (compiles, subprocesses, timers) per batch |
+| step-wiring       | nn/ + parallel/       | donated-carry jit built outside nn/step_program.py |
 
 Each checker yields ``engine.Finding`` objects; inline
 ``# graftlint: disable=<rule>`` suppressions are honored by
@@ -42,6 +43,7 @@ ALL_RULES = (
     "monotonic-clock",
     "cost-analysis-off-hot-path",
     "tuner-off-hot-path",
+    "step-wiring",
 )
 
 # numpy calls that only touch metadata — safe on tracers and device arrays
@@ -79,6 +81,8 @@ def run(index: Index, rules: Optional[Sequence[str]] = None) -> List[Finding]:
         out += _rule_cost_analysis_off_hot_path(index)
     if "tuner-off-hot-path" in active:
         out += _rule_tuner_off_hot_path(index)
+    if "step-wiring" in active:
+        out += _rule_step_wiring(index)
     # drop duplicates (one line can trip a rule through several sub-checks)
     seen: Set[tuple] = set()
     uniq = []
@@ -699,4 +703,41 @@ def _rule_tuner_off_hot_path(index: Index) -> List[Finding]:
                     "tune.maybe_apply at startup instead")
                 if f:
                     out.append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# step-wiring: compiled-step construction outside the step-program module
+# ---------------------------------------------------------------------------
+
+
+def _rule_step_wiring(index: Index) -> List[Finding]:
+    """Direct ``jax.jit(..., donate_argnums=...)`` in ``nn/`` or
+    ``parallel/`` outside ``nn/step_program.py``. A donated-carry jit IS a
+    training/serving step executable, and the framework's step wiring
+    (trace sites, AOT warm registration, retrace-guard hookup, the
+    grad-accumulation scan) lives in exactly one place — ``StepProgram``.
+    Hand-rolled step jits fork that policy a sixth time: they silently miss
+    AOT warmup, guard budgets, and the cost-exemplar harvest (ISSUE 13;
+    docs/PARALLELISM.md)."""
+    out = []
+    for q in sorted(index.functions):
+        fi = index.functions[q]
+        p = "/" + fi.module.relpath.replace("\\", "/")
+        if "/nn/" not in p and "/parallel/" not in p:
+            continue
+        if p.endswith("/step_program.py"):
+            continue
+        for node in own_nodes(fi.node):
+            if not (isinstance(node, ast.Call) and is_jit_call(node, fi.module)):
+                continue
+            if not any(kw.arg == "donate_argnums" for kw in node.keywords):
+                continue
+            f = index.make_finding(
+                "step-wiring", fi, node.lineno,
+                "donated-carry jit built outside nn/step_program.py: step "
+                "executables must go through StepProgram so trace/donate/"
+                "AOT-warm/retrace-guard policy stays in one place")
+            if f:
+                out.append(f)
     return out

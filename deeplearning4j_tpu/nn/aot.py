@@ -526,7 +526,10 @@ def _selftest() -> dict:
         def f(w, x):
             return jnp.tanh(x @ w) * 0.5 + x.sum()
 
-        jitted = jax.jit(f, donate_argnums=(0,) if donate else ())
+        # probe executable, not a training step — exempt from the
+        # one-step-program rule
+        jitted = jax.jit(  # graftlint: disable=step-wiring
+            f, donate_argnums=(0,) if donate else ())
         mk = lambda: (
             jnp.asarray(np.linspace(-1.0, 1.0, shape[1] * shape[1],
                                     dtype=np.float32).reshape(shape[1],

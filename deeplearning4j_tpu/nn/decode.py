@@ -213,8 +213,12 @@ class DecodeProgram:
                 self._geom.append((int(layer.n_heads),
                                    C // int(layer.n_heads)))
         self.pools = self._alloc_pools()
-        self._fn = aot.wrap(jax.jit(self._step, donate_argnums=(2,)),
-                            SITE, model=model)
+        # the serve executor's step program: donates only the cache pools
+        # (params/state are shared across concurrent streams)
+        from deeplearning4j_tpu.nn.step_program import StepProgram
+
+        self._fn = StepProgram(self._step, SITE, model=model,
+                               donate_argnums=(2,))
 
     # -- cache allocation ---------------------------------------------------
 

@@ -885,8 +885,11 @@ class ComputationGraph:
 
     # -- jitted step -------------------------------------------------------
     def _make_step(self, with_carries: bool = False):
-        return jax.jit(self._make_step_body(with_carries),
-                       donate_argnums=(0, 1, 2))
+        from deeplearning4j_tpu.nn.step_program import StepProgram
+
+        site = "cg.step.tbptt" if with_carries else "cg.step"
+        return StepProgram(self._make_step_body(with_carries), site,
+                           model=self, hits_site="cg.fit")
 
     def _make_step_body(self, with_carries: bool = False, grad_exchange=None):
         """The pure training-step closure. ``grad_exchange`` (a
@@ -1009,12 +1012,10 @@ class ComputationGraph:
     def _get_step_fn(self, with_carries: bool):
         if with_carries:
             if self._tbptt_step_fn is None:
-                self._tbptt_step_fn = aot.wrap(
-                    self._make_step(True), "cg.step.tbptt", model=self)
+                self._tbptt_step_fn = self._make_step(True)
             return self._tbptt_step_fn
         if self._step_fn is None:
-            self._step_fn = aot.wrap(
-                self._make_step(False), "cg.step", model=self)
+            self._step_fn = self._make_step(False)
         return self._step_fn
 
     # -- chained steps (K per dispatch; mirrors MultiLayerNetwork) ---------
@@ -1045,7 +1046,11 @@ class ComputationGraph:
                 (inputs_k, labels_k))
             return p, o, s, losses
 
-        return jax.jit(chain, donate_argnums=(0, 1, 2))
+        from deeplearning4j_tpu.nn.step_program import StepProgram
+
+        # aot_wrap=False: chained dispatch bypasses the AOT warm dispatcher;
+        # the StepProgram still runs the lazy cost-exemplar harvest
+        return StepProgram(chain, "cg.chain", aot_wrap=False)
 
     def _get_chain_step(self):
         if getattr(self, "_chain_step_fn", None) is None:
@@ -1340,16 +1345,16 @@ class ComputationGraph:
             chaos.maybe_slow(self.iteration)
             f = chaos.maybe_nan_batch(self.iteration, f)
         step = self._get_step_fn(False)
-        self.params, self.opt_state, self.state, _, loss = step(
+        # dispatch() runs the step, then the retrace-guard check the program
+        # owns: traces land at cg.step (inside the jitted body), bucket
+        # traffic lands at cg.fit (pad_fit_multi) — the guard joins the two
+        self.params, self.opt_state, self.state, _, loss = step.dispatch(
             self.params, self.opt_state, self.state,
             jnp.asarray(self.iteration, jnp.int32), self._next_rng(),
             self._input_dict(f), l, self._mask_dict(fm), lm, {},
             ex_weight=jnp.asarray(ew, self.dtype) if ew is not None else None,
         )
         self.iteration += 1
-        # traces land at cg.step (inside the jitted body); bucket traffic
-        # lands at cg.fit (pad_fit_multi) — the guard joins the two
-        retrace_guard.check_if_enabled("cg.step", hits_site="cg.fit")
         return loss
 
     def _fit_tbptt(self, f, l, fm, lm):
@@ -1430,7 +1435,10 @@ class ComputationGraph:
                                               rngs=None, masks=masks)
                 return tuple(acts[o] for o in self.conf.outputs)
 
-            self._output_fn = aot.wrap(jax.jit(fwd), "cg.output", model=self)
+            from deeplearning4j_tpu.nn.step_program import StepProgram
+
+            self._output_fn = StepProgram(
+                fwd, "cg.output", model=self, donate_argnums=())
         return self._output_fn
 
     def output(self, *xs, fmasks=None):
@@ -1458,15 +1466,14 @@ class ComputationGraph:
                     if fm is not None:
                         fm = tuple(bucketing.pad_rows_zero(m, target)
                                    if m is not None else None for m in fm)
-                    outs = self._output_fn(self.params, self.state,
-                                           self._input_dict(feats),
-                                           self._mask_dict(fm))
+                    outs = self._output_fn.dispatch(
+                        self.params, self.state, self._input_dict(feats),
+                        self._mask_dict(fm))
                     outs = tuple(bucketing.unpad(o, n) for o in outs)
-                    retrace_guard.check_if_enabled("cg.output")
                     return outs[0] if len(outs) == 1 else outs
-            outs = self._output_fn(self.params, self.state, self._input_dict(feats),
-                                   self._mask_dict(fm))
-            retrace_guard.check_if_enabled("cg.output")
+            outs = self._output_fn.dispatch(
+                self.params, self.state, self._input_dict(feats),
+                self._mask_dict(fm))
         return outs[0] if len(outs) == 1 else outs
 
     # -- streaming RNN inference (ComputationGraph.rnnTimeStep:2718) -------

@@ -165,136 +165,18 @@ def _as_batch(batch):
     return batch, None, None, None
 
 
-# Above this parameter count, "auto" never chains: big models are
-# compute-bound, so amortizing dispatch buys nothing and the stacked
-# [K, B, ...] batch just costs memory.
-CHAIN_AUTO_PARAM_LIMIT = 2_000_000
-
-_CHAIN_RNG_WARNED = False
-
-
-def _chain_k_from_env(uses_rng: bool, n_params: int) -> int:
-    """Shared chained-fit gate for MultiLayerNetwork and ComputationGraph:
-    DL4J_TPU_CHAIN_STEPS forces a count (0 disables); "auto" chains 8 only
-    for rng-free models small enough to be dispatch-bound. Phase-span
-    profiling (DL4J_TPU_PHASE_SPANS=1) disables auto-chaining: its whole
-    point is per-phase dispatch, which a K-step chain would hide — an
-    explicit DL4J_TPU_CHAIN_STEPS count still wins."""
-    import os as _os
-
-    env = _os.environ.get("DL4J_TPU_CHAIN_STEPS", "auto")
-    if env == "auto" and obs.phase_spans_enabled():
-        return 0
-    if env != "auto":
-        try:
-            k = max(int(env), 0)
-        except ValueError:
-            return 0
-        if k > 1 and uses_rng:
-            global _CHAIN_RNG_WARNED
-            if not _CHAIN_RNG_WARNED:
-                _CHAIN_RNG_WARNED = True
-                import warnings
-
-                warnings.warn(
-                    f"DL4J_TPU_CHAIN_STEPS={env} forces chained dispatch on a "
-                    "model that draws randomness (dropout/weight noise): "
-                    "per-step rngs derive as fold_in(rng, i) inside the "
-                    "chain, a different-but-equivalent stream from the "
-                    "per-step path, so losses will not be bitwise "
-                    "reproducible against unchained runs.")
-        return k
-    return 8 if (not uses_rng and n_params < CHAIN_AUTO_PARAM_LIMIT) else 0
-
-
-_GRAD_ACCUM_WARNED = False
-
-
-def _grad_accum_from_env() -> int:
-    """Micro-batch count for gradient accumulation inside the jitted step
-    (DL4J_TPU_GRAD_ACCUM, default 1 = off). Shared by MultiLayerNetwork and
-    ComputationGraph; read at step-BUILD time, so a change after the first
-    compile needs ``_clear_compiled()`` (the tuner's trial subprocesses get
-    a fresh build for free). See docs/TUNING.md."""
-    import os as _os
-
-    env = _os.environ.get("DL4J_TPU_GRAD_ACCUM", "1")
-    try:
-        return max(int(env), 1)
-    except ValueError:
-        return 1
-
-
-def _accum_applicable(accum: int, batch) -> bool:
-    """Trace-time gate for the accumulated step: every batch-major leaf must
-    share one leading row count divisible by ``accum`` (micro-batches must be
-    equal-sized for the mean-of-means loss to equal the full-batch mean).
-    Falls back to the un-accumulated step otherwise — silently for accum<=1,
-    with a one-shot warning when the knob is set but the batch doesn't fit."""
-    if accum <= 1:
-        return False
-    leaves = jax.tree_util.tree_leaves(batch)
-    if not leaves or leaves[0].ndim == 0:
-        return False
-    b = leaves[0].shape[0]
-    if b < accum or b % accum != 0 or not all(
-            l.ndim >= 1 and l.shape[0] == b for l in leaves):
-        # warn-once flag: once-per-trace IS the wanted semantic here, and
-        # the boolean never feeds the traced computation
-        global _GRAD_ACCUM_WARNED  # graftlint: disable=jit-purity
-        if not _GRAD_ACCUM_WARNED:
-            _GRAD_ACCUM_WARNED = True
-            import warnings
-
-            warnings.warn(
-                f"DL4J_TPU_GRAD_ACCUM={accum} does not divide the batch "
-                f"(leading dims {[l.shape[0] for l in leaves[:4]]}); this "
-                "step runs un-accumulated.")
-        return False
-    return True
-
-
-def _accum_value_and_grad(accum, params, state, batch, rng, make_loss_fn):
-    """Gradient accumulation: one ``lax.scan`` over ``accum`` equal
-    micro-batches INSIDE the donated step executable. Each micro-batch runs
-    forward + backward at 1/accum the activation footprint (the scan re-uses
-    one micro-batch's live activations — this is the knob that unlocks
-    batches beyond HBM); gradients accumulate in a carry and are averaged
-    once, so the single optimizer update downstream sees exactly the
-    mean-of-micro-means gradient. For equal micro-batches with no masks that
-    equals the full-batch mean bitwise up to fp summation order (the parity
-    test pins fp32 tolerance); per-micro-batch means under row masks follow
-    the same mean-of-means contract the DP replica exchange already uses.
-
-    ``batch`` is a pytree of batch-major arrays (None leaves allowed).
-    ``make_loss_fn(micro_batch, state, rng_i)`` returns the per-micro-batch
-    ``loss_fn(params) -> (loss, (new_state, aux))``. Mutable layer state
-    (BatchNorm running stats) threads micro-batch to micro-batch, matching
-    what sequential small batches would do. Per-micro rngs derive as
-    ``fold_in(rng, i)`` — a different-but-equivalent stream from the
-    un-accumulated step for models that draw randomness (same caveat as
-    chained dispatch)."""
-    micro = jax.tree_util.tree_map(
-        lambda t: t.reshape((accum, t.shape[0] // accum) + t.shape[1:]),
-        batch)
-
-    def body(carry, mb):
-        st, g_acc, loss_acc, i = carry
-        loss_fn = make_loss_fn(mb, st, jax.random.fold_in(rng, i))
-        (loss_i, (st_i, _)), g_i = jax.value_and_grad(
-            loss_fn, has_aux=True)(params)
-        g_acc = jax.tree_util.tree_map(lambda a, g: a + g, g_acc, g_i)
-        return (st_i, g_acc, loss_acc + loss_i, i + 1), None
-
-    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
-    (new_state, g_sum, loss_sum, _), _ = jax.lax.scan(
-        body,
-        (state, zeros, jnp.asarray(0.0, jnp.float32),
-         jnp.asarray(0, jnp.int32)),
-        micro)
-    inv = 1.0 / accum
-    grads = jax.tree_util.tree_map(lambda g: g * inv, g_sum)
-    return loss_sum * inv, new_state, grads
+# The shared micro-batching policy (chained dispatch, grad-accumulation
+# scan) and the compiled-step wiring now live in nn/step_program.py — the
+# single step-program module (ISSUE 13). The underscore aliases keep the
+# historical import surface (nn.graph, parallel/, tests) intact.
+from deeplearning4j_tpu.nn.step_program import (  # noqa: F401,E402
+    CHAIN_AUTO_PARAM_LIMIT,
+    StepProgram,
+    accum_applicable as _accum_applicable,
+    accum_value_and_grad as _accum_value_and_grad,
+    chain_k_from_env as _chain_k_from_env,
+    grad_accum_from_env as _grad_accum_from_env,
+)
 
 
 def _sig_dtype(a):
@@ -564,8 +446,10 @@ class MultiLayerNetwork:
         return loss + reg, (new_state, new_carries)
 
     # -- jitted step -------------------------------------------------------
-    def _make_step(self, with_carries: bool):
-        return jax.jit(self._step_body(with_carries), donate_argnums=(0, 1, 2))
+    def _make_step(self, with_carries: bool) -> StepProgram:
+        site = "mln.step.tbptt" if with_carries else "mln.step"
+        return StepProgram(self._step_body(with_carries), site, model=self,
+                           hits_site="mln.fit")
 
     def _step_body(self, with_carries: bool, grad_exchange=None):
         """The pure training-step closure. ``grad_exchange`` (a
@@ -717,7 +601,14 @@ class MultiLayerNetwork:
             bucketing.telemetry().record_trace("mln.phase.update", ())
             return self._update_params(params, opt_state, grads, it)
 
-        return jax.jit(fwd), jax.jit(bwd), jax.jit(upd)
+        return (
+            StepProgram(fwd, "mln.phase.fwd", donate_argnums=(),
+                        aot_wrap=False),
+            StepProgram(bwd, "mln.phase.bwd", donate_argnums=(),
+                        aot_wrap=False),
+            StepProgram(upd, "mln.phase.update", donate_argnums=(),
+                        aot_wrap=False),
+        )
 
     def _get_phase_fns(self):
         if getattr(self, "_phase_fns", None) is None:
@@ -781,7 +672,10 @@ class MultiLayerNetwork:
                 (xs, ys))
             return p, o, s, losses
 
-        return jax.jit(chain, donate_argnums=(0, 1, 2))
+        # aot_wrap=False: the chained executable bypasses the AOT warm
+        # dispatcher (its [K, B, ...] signature never matches the ladder);
+        # StepProgram still runs the lazy cost-exemplar harvest for it
+        return StepProgram(chain, "mln.chain", aot_wrap=False)
 
     def _get_chain_step(self):
         if getattr(self, "_chain_step_fn", None) is None:
@@ -791,12 +685,10 @@ class MultiLayerNetwork:
     def _get_step_fn(self, with_carries: bool):
         if with_carries:
             if self._tbptt_step_fn is None:
-                self._tbptt_step_fn = aot.wrap(
-                    self._make_step(True), "mln.step.tbptt", model=self)
+                self._tbptt_step_fn = self._make_step(True)
             return self._tbptt_step_fn
         if self._step_fn is None:
-            self._step_fn = aot.wrap(
-                self._make_step(False), "mln.step", model=self)
+            self._step_fn = self._make_step(False)
         return self._step_fn
 
     # -- training ----------------------------------------------------------
@@ -821,14 +713,10 @@ class MultiLayerNetwork:
         args = (self.params, self.opt_state, self.state,
                 jnp.asarray(self.iteration, jnp.int32), self._next_rng(),
                 xs, ys)
+        # the StepProgram runs the lazy cost-exemplar harvest itself (aval
+        # capture only on the rare compile path — donation invalidates
+        # buffers, not shapes/dtypes)
         self.params, self.opt_state, self.state, _ = chain(*args)
-        # chained dispatches bypass AotFunction, so the lazy cost harvest
-        # hooks in here: aval capture only on the (rare) compile path —
-        # donation invalidates buffers, not shapes/dtypes
-        from deeplearning4j_tpu.obs import profile as _profile
-
-        if _profile.wants_exemplar("mln.chain"):
-            _profile.note_exemplar("mln.chain", chain, args, {})
         self.iteration += len(buf)
 
     def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None,
@@ -996,16 +884,16 @@ class MultiLayerNetwork:
             # stays the production path
             return self._fit_batch_phases(x, y, fm, lm, ew)
         step = self._get_step_fn(False)
-        self.params, self.opt_state, self.state, _, loss = step(
+        # dispatch() runs the step, then the retrace-guard check the program
+        # owns: traces land at mln.step (inside the jitted body), bucket
+        # traffic lands at mln.fit (pad_fit_batch) — the guard joins the two
+        self.params, self.opt_state, self.state, _, loss = step.dispatch(
             self.params, self.opt_state, self.state,
             jnp.asarray(self.iteration, jnp.int32), self._next_rng(),
             x, y, fm, lm, (),
             ex_weight=jnp.asarray(ew, self.dtype) if ew is not None else None,
         )
         self.iteration += 1
-        # traces land at mln.step (inside the jitted body); bucket traffic
-        # lands at mln.fit (pad_fit_batch) — the guard joins the two
-        retrace_guard.check_if_enabled("mln.step", hits_site="mln.fit")
         return loss
 
     def _fit_solver(self, x, y, fm, lm):
@@ -1076,7 +964,8 @@ class MultiLayerNetwork:
                                               fmask=fmask)
                 return a
 
-            self._output_fn = aot.wrap(jax.jit(fwd), "mln.output", model=self)
+            self._output_fn = StepProgram(
+                fwd, "mln.output", model=self, donate_argnums=())
         return self._output_fn
 
     def output(self, x, train: bool = False, fmask=None):
@@ -1099,12 +988,10 @@ class MultiLayerNetwork:
                 if target > n:
                     x = bucketing.pad_rows_zero(x, target)
                     fmask = bucketing.pad_rows_zero(fmask, target)
-                    out = bucketing.unpad(
-                        self._output_fn(self.params, self.state, x, fmask), n)
-                    retrace_guard.check_if_enabled("mln.output")
-                    return out
-            out = self._output_fn(self.params, self.state, x, fmask)
-            retrace_guard.check_if_enabled("mln.output")
+                    return bucketing.unpad(
+                        self._output_fn.dispatch(
+                            self.params, self.state, x, fmask), n)
+            out = self._output_fn.dispatch(self.params, self.state, x, fmask)
         return out
 
     def predict(self, x) -> np.ndarray:

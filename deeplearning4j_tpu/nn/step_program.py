@@ -1,0 +1,317 @@
+"""One compiled step program: the single owner of step wiring policy.
+
+Every training/inference entry point in the framework used to hand-roll the
+same five-line stanza — ``jax.jit(body, donate_argnums=(0, 1, 2))``,
+``aot.wrap`` at a site name, a ``retrace_guard.check_if_enabled`` after each
+dispatch, a grad-accumulation scan spliced into the body, and an exemplar
+harvest for the cost model. MultiLayerNetwork, ComputationGraph,
+DataParallelStep, the gpipe stages and the serve/decode executors each
+carried their own copy, and the copies drifted (ISSUE 13). This module is
+now the only place that wiring exists:
+
+- :class:`StepProgram` — one compiled entry point: trace/donate policy,
+  AOT-warm dispatch (``nn/aot.py``), retrace-guard hookup
+  (``analysis/retrace_guard.py``) and cost-exemplar harvest, behind a
+  callable that quacks like the ``AotFunction`` it wraps.
+- the **micro-batching policy** shared by every step builder:
+  :func:`grad_accum_from_env` / :func:`accum_applicable` /
+  :func:`accum_value_and_grad` (the lax.scan gradient accumulation INSIDE
+  the donated step) and :func:`chain_k_from_env` (K steps per dispatch).
+- the **mesh-shape policy**: :func:`mesh_shape_from_env` resolves the
+  ``(data, tensor, stage)`` axes of the named-mesh step
+  (``parallel/mesh_step.py``) from the ``DL4J_TPU_MESH_*`` knobs that
+  ``tune/knobs.py`` registers for the successive-halving search.
+
+A graftlint rule (``step-wiring``, ``analysis/rules.py``) forbids new
+direct ``jax.jit(..., donate_argnums=...)`` step construction in ``nn/``
+and ``parallel/`` outside this module, so the wiring cannot fork a sixth
+time. See docs/PARALLELISM.md.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import obs
+from deeplearning4j_tpu.analysis import retrace_guard
+
+__all__ = [
+    "CHAIN_AUTO_PARAM_LIMIT",
+    "StepProgram",
+    "accum_applicable",
+    "accum_value_and_grad",
+    "chain_k_from_env",
+    "grad_accum_from_env",
+    "mesh_shape_from_env",
+]
+
+
+class StepProgram:
+    """One compiled step/output program and its dispatch policy.
+
+    Owns, in exactly one place, what every model/parallel step used to wire
+    by hand:
+
+    - **trace/donate**: ``body`` is jitted with ``donate_argnums`` (the
+      params/opt/state carry donates by default, so the step updates in
+      place buffer-wise);
+    - **AOT**: the jitted function is registered at ``site`` on ``model``'s
+      AOT registry (``aot.wrap``) so ladder warmup, bundle persistence and
+      warm dispatch all find it — ``aot_wrap=False`` opts out for entry
+      points that must bypass the AOT dispatcher (chained steps, phase
+      profiling) while keeping the lazy cost-exemplar harvest;
+    - **retrace guard**: :meth:`dispatch` runs the call followed by the
+      guard check for ``guard_site`` (defaults to ``site``) with the
+      configured ``hits_site``/``extra_allowed``, so callers can't forget
+      the check or disagree on the budget.
+
+    ``wrap_body`` (e.g. a ``shard_map`` closure for the explicit DP
+    exchange) transforms the body before jit. Everything not implemented
+    here delegates to the wrapped callable, so existing code that expects
+    an ``AotFunction`` (``warm``/``compiled_count``/``signatures``/
+    ``install``/``lower``) keeps working unchanged.
+    """
+
+    def __init__(self, body: Callable, site: str, *, model=None,
+                 donate_argnums: Tuple[int, ...] = (0, 1, 2),
+                 static_argnums: Optional[Tuple[int, ...]] = None,
+                 wrap_body: Optional[Callable[[Callable], Callable]] = None,
+                 aot_wrap: bool = True,
+                 guard_site: Optional[str] = None,
+                 hits_site: Optional[str] = None,
+                 extra_allowed: int = 0):
+        from deeplearning4j_tpu.nn import aot
+
+        self.site = site
+        self.guard_site = guard_site or site
+        self.hits_site = hits_site
+        self.extra_allowed = extra_allowed
+        self.donate_argnums = tuple(donate_argnums)
+        fn = body if wrap_body is None else wrap_body(body)
+        kwargs: dict = {"donate_argnums": self.donate_argnums}
+        if static_argnums is not None:
+            kwargs["static_argnums"] = tuple(static_argnums)
+        jitted = jax.jit(fn, **kwargs)
+        self._aot = bool(aot_wrap)
+        self._fn = aot.wrap(jitted, site, model=model) if aot_wrap else jitted
+
+    # -- dispatch ----------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        out = self._fn(*args, **kwargs)
+        if not self._aot:
+            # plain-jit programs (chained dispatch, phase fns) still feed
+            # the cost model: aval capture only on the (rare) compile path
+            from deeplearning4j_tpu.obs import profile as _profile
+
+            if _profile.wants_exemplar(self.site):
+                _profile.note_exemplar(self.site, self._fn, args, kwargs)
+        return out
+
+    def dispatch(self, *args, **kwargs):
+        """Call, then run the retrace-guard check this program owns."""
+        out = self(*args, **kwargs)
+        self.guard()
+        return out
+
+    def guard(self):
+        """The post-dispatch retrace-guard check (no-op unless enabled)."""
+        retrace_guard.check_if_enabled(
+            self.guard_site, hits_site=self.hits_site,
+            extra_allowed=self.extra_allowed)
+
+    # -- AotFunction parity ------------------------------------------------
+    def warm(self, *args, **kwargs):
+        return self._fn.warm(*args, **kwargs)
+
+    @property
+    def compiled_count(self) -> int:
+        return getattr(self._fn, "compiled_count", 0)
+
+    def __getattr__(self, name: str):
+        # anything else (signatures/install/lower/_compiled/...) is the
+        # wrapped callable's business
+        return getattr(self.__dict__["_fn"], name)
+
+
+# ---------------------------------------------------------------------------
+# Micro-batching policy (shared by MLN / CG / DP / mesh step builders)
+# ---------------------------------------------------------------------------
+
+# Above this parameter count, "auto" never chains: big models are
+# compute-bound, so amortizing dispatch buys nothing and the stacked
+# [K, B, ...] batch just costs memory.
+CHAIN_AUTO_PARAM_LIMIT = 2_000_000
+
+_CHAIN_RNG_WARNED = False
+
+
+def chain_k_from_env(uses_rng: bool, n_params: int) -> int:
+    """Shared chained-fit gate for MultiLayerNetwork and ComputationGraph:
+    DL4J_TPU_CHAIN_STEPS forces a count (0 disables); "auto" chains 8 only
+    for rng-free models small enough to be dispatch-bound. Phase-span
+    profiling (DL4J_TPU_PHASE_SPANS=1) disables auto-chaining: its whole
+    point is per-phase dispatch, which a K-step chain would hide — an
+    explicit DL4J_TPU_CHAIN_STEPS count still wins."""
+    import os as _os
+
+    env = _os.environ.get("DL4J_TPU_CHAIN_STEPS", "auto")
+    if env == "auto" and obs.phase_spans_enabled():
+        return 0
+    if env != "auto":
+        try:
+            k = max(int(env), 0)
+        except ValueError:
+            return 0
+        if k > 1 and uses_rng:
+            global _CHAIN_RNG_WARNED
+            if not _CHAIN_RNG_WARNED:
+                _CHAIN_RNG_WARNED = True
+                import warnings
+
+                warnings.warn(
+                    f"DL4J_TPU_CHAIN_STEPS={env} forces chained dispatch on a "
+                    "model that draws randomness (dropout/weight noise): "
+                    "per-step rngs derive as fold_in(rng, i) inside the "
+                    "chain, a different-but-equivalent stream from the "
+                    "per-step path, so losses will not be bitwise "
+                    "reproducible against unchained runs.")
+        return k
+    return 8 if (not uses_rng and n_params < CHAIN_AUTO_PARAM_LIMIT) else 0
+
+
+_GRAD_ACCUM_WARNED = False
+
+
+def grad_accum_from_env() -> int:
+    """Micro-batch count for gradient accumulation inside the jitted step
+    (DL4J_TPU_GRAD_ACCUM, default 1 = off). Shared by MultiLayerNetwork and
+    ComputationGraph; read at step-BUILD time, so a change after the first
+    compile needs ``_clear_compiled()`` (the tuner's trial subprocesses get
+    a fresh build for free). See docs/TUNING.md."""
+    import os as _os
+
+    env = _os.environ.get("DL4J_TPU_GRAD_ACCUM", "1")
+    try:
+        return max(int(env), 1)
+    except ValueError:
+        return 1
+
+
+def accum_applicable(accum: int, batch) -> bool:
+    """Trace-time gate for the accumulated step: every batch-major leaf must
+    share one leading row count divisible by ``accum`` (micro-batches must be
+    equal-sized for the mean-of-means loss to equal the full-batch mean).
+    Falls back to the un-accumulated step otherwise — silently for accum<=1,
+    with a one-shot warning when the knob is set but the batch doesn't fit."""
+    if accum <= 1:
+        return False
+    leaves = jax.tree_util.tree_leaves(batch)
+    if not leaves or leaves[0].ndim == 0:
+        return False
+    b = leaves[0].shape[0]
+    if b < accum or b % accum != 0 or not all(
+            l.ndim >= 1 and l.shape[0] == b for l in leaves):
+        # warn-once flag: once-per-trace IS the wanted semantic here, and
+        # the boolean never feeds the traced computation
+        global _GRAD_ACCUM_WARNED  # graftlint: disable=jit-purity
+        if not _GRAD_ACCUM_WARNED:
+            _GRAD_ACCUM_WARNED = True
+            import warnings
+
+            warnings.warn(
+                f"DL4J_TPU_GRAD_ACCUM={accum} does not divide the batch "
+                f"(leading dims {[l.shape[0] for l in leaves[:4]]}); this "
+                "step runs un-accumulated.")
+        return False
+    return True
+
+
+def accum_value_and_grad(accum, params, state, batch, rng, make_loss_fn):
+    """Gradient accumulation: one ``lax.scan`` over ``accum`` equal
+    micro-batches INSIDE the donated step executable. Each micro-batch runs
+    forward + backward at 1/accum the activation footprint (the scan re-uses
+    one micro-batch's live activations — this is the knob that unlocks
+    batches beyond HBM); gradients accumulate in a carry and are averaged
+    once, so the single optimizer update downstream sees exactly the
+    mean-of-micro-means gradient. For equal micro-batches with no masks that
+    equals the full-batch mean bitwise up to fp summation order (the parity
+    test pins fp32 tolerance); per-micro-batch means under row masks follow
+    the same mean-of-means contract the DP replica exchange already uses.
+
+    ``batch`` is a pytree of batch-major arrays (None leaves allowed).
+    ``make_loss_fn(micro_batch, state, rng_i)`` returns the per-micro-batch
+    ``loss_fn(params) -> (loss, (new_state, aux))``. Mutable layer state
+    (BatchNorm running stats) threads micro-batch to micro-batch, matching
+    what sequential small batches would do. Per-micro rngs derive as
+    ``fold_in(rng, i)`` — a different-but-equivalent stream from the
+    un-accumulated step for models that draw randomness (same caveat as
+    chained dispatch)."""
+    micro = jax.tree_util.tree_map(
+        lambda t: t.reshape((accum, t.shape[0] // accum) + t.shape[1:]),
+        batch)
+
+    def body(carry, mb):
+        st, g_acc, loss_acc, i = carry
+        loss_fn = make_loss_fn(mb, st, jax.random.fold_in(rng, i))
+        (loss_i, (st_i, _)), g_i = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        g_acc = jax.tree_util.tree_map(lambda a, g: a + g, g_acc, g_i)
+        return (st_i, g_acc, loss_acc + loss_i, i + 1), None
+
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    (new_state, g_sum, loss_sum, _), _ = jax.lax.scan(
+        body,
+        (state, zeros, jnp.asarray(0.0, jnp.float32),
+         jnp.asarray(0, jnp.int32)),
+        micro)
+    inv = 1.0 / accum
+    grads = jax.tree_util.tree_map(lambda g: g * inv, g_sum)
+    return loss_sum * inv, new_state, grads
+
+
+# ---------------------------------------------------------------------------
+# Mesh-shape policy (the (d, t, s) knobs of the named-mesh step)
+# ---------------------------------------------------------------------------
+
+
+def _axis_env(name: str) -> int:
+    import os as _os
+
+    raw = _os.environ.get(name, "0")
+    try:
+        return max(int(raw), 0)
+    except ValueError:
+        return 0
+
+
+def mesh_shape_from_env(n_devices: int) -> Tuple[int, int, int]:
+    """Resolve the named-mesh step's ``(data, tensor, stage)`` shape from
+    the ``DL4J_TPU_MESH_DATA`` / ``DL4J_TPU_MESH_MODEL`` /
+    ``DL4J_TPU_MESH_PIPE`` knobs (``tune/knobs.py``; 0/unset = auto).
+
+    Auto policy: unset tensor/stage axes default to 1 and the unset data
+    axis absorbs every remaining device — so with no knobs set this is pure
+    DP over all devices, the baseline the MULTICHIP bench gate compares
+    tuned shapes against. A shape whose product does not divide
+    ``n_devices`` is a configuration error and raises (the knob domains the
+    tuner searches are derived from the local device count precisely so its
+    trials never land here)."""
+    t = _axis_env("DL4J_TPU_MESH_MODEL") or 1
+    s = _axis_env("DL4J_TPU_MESH_PIPE") or 1
+    d = _axis_env("DL4J_TPU_MESH_DATA")
+    if d == 0:
+        if n_devices % (t * s):
+            raise ValueError(
+                f"mesh axes model={t} x pipe={s} do not divide "
+                f"{n_devices} devices")
+        d = n_devices // (t * s)
+    if d * t * s != n_devices:
+        raise ValueError(
+            f"mesh shape (d={d}, t={t}, s={s}) does not cover "
+            f"{n_devices} devices")
+    return d, t, s

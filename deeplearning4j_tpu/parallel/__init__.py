@@ -42,6 +42,7 @@ from deeplearning4j_tpu.parallel.gpipe import GPipeTrainer
 from deeplearning4j_tpu.parallel.ring import local_attention, ring_self_attention
 from deeplearning4j_tpu.parallel.pipeline import PipelineParallel, stack_stage_params
 from deeplearning4j_tpu.parallel.tp import ShardedTrainer, tp_param_shardings
+from deeplearning4j_tpu.parallel.mesh_step import MeshTrainer, shard_update_spec
 
 __all__ = [
     "MeshSpec", "make_mesh", "ParallelWrapper", "ParallelInference",
@@ -50,6 +51,7 @@ __all__ = [
     "tp_param_shardings", "init_distributed", "shutdown_distributed",
     "is_multihost", "global_array", "replicate_global",
     "DataParallelStep", "GradExchange", "data_axis_size", "data_sharded",
+    "MeshTrainer", "shard_update_spec",
     "threshold_encode", "threshold_decode", "pack_ternary", "unpack_ternary",
     "encode_packed", "decode_gathered", "packed_nbytes",
 ]

@@ -661,8 +661,14 @@ class GPipeTrainer:
             return apply_update(params, opt_state, bn_state, it, loss, aux,
                                 grads)
 
+        from deeplearning4j_tpu.nn.step_program import StepProgram
+
         if not has_gn:
-            return jax.jit(step, donate_argnums=(0, 1, 2))
+            # aot_wrap=False: the gpipe stage-switched executable is built
+            # per trainer and warmed by its first dispatch (no bucket ladder
+            # over [S, M, mb, W] stacks); StepProgram still owns the
+            # donate/trace policy and the cost-exemplar harvest
+            return StepProgram(step, "gpipe.step", aot_wrap=False)
 
         # Gradient normalization must NOT run inside a jitted executable
         # that also sees the pipe-sharded state: the GSPMD partitioner
@@ -678,11 +684,14 @@ class GPipeTrainer:
         # the [S, Lmax] stage vectors between the two executables — a few
         # tiny elementwise/norm dispatches per step, only for gn-bearing
         # configs — and the (linear-in-grads) updater half stays jitted.
-        grads_jit = jax.jit(
+        # (standalone repro: tools/repro_gpipe_clip_miscompile.py; tracked
+        # in docs/TEST_DEBT.md — retire this split once a fixed XLA lands)
+        grads_jit = StepProgram(
             lambda params, x_micro, y_micro, rng, masks_all=None,
             head_mask=None: jax.value_and_grad(self._loss, has_aux=True)(
-                params, x_micro, y_micro, rng, masks_all, head_mask))
-        update_jit = jax.jit(apply_update, donate_argnums=(0, 1, 2))
+                params, x_micro, y_micro, rng, masks_all, head_mask),
+            "gpipe.grads", donate_argnums=(), aot_wrap=False)
+        update_jit = StepProgram(apply_update, "gpipe.update", aot_wrap=False)
 
         def split_step(params, opt_state, bn_state, it, x_micro, y_micro,
                        rng, masks_all=None, head_mask=None):

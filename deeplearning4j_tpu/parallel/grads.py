@@ -402,18 +402,22 @@ class DataParallelStep:
         in_specs = (repl, (opt_spec, dp), repl, repl, repl,
                     dp, dp, dp, dp, repl, dp)
         out_specs = (repl, (opt_spec, dp), repl, repl, repl)
-        from deeplearning4j_tpu.nn import aot
+        from deeplearning4j_tpu.nn.step_program import StepProgram
 
-        jitted = jax.jit(
-            shard_map(call, mesh=self.mesh, in_specs=in_specs,
-                      out_specs=out_specs, check_rep=False),
-            donate_argnums=(0, 1, 2))
         # the grad-exchange step is its own AOT site: the compressed/sharded
         # exchange traces a different executable than the single-chip step,
         # and warmup (aot.warm_dp) / bundle restore must target it. NOT
         # registered under the model's step sites — rebuild_step()/reload()
-        # call here again and replace the wrapper wholesale.
-        return aot.wrap(jitted, "dp.step", model=self.model)
+        # call here again and replace the wrapper wholesale. The guard still
+        # watches the model's step site (traces fire inside the body) against
+        # dp.fit bucket traffic, +1 for the exchange's own executable.
+        return StepProgram(
+            call, "dp.step", model=self.model,
+            wrap_body=lambda b: shard_map(
+                b, mesh=self.mesh, in_specs=in_specs,
+                out_specs=out_specs, check_rep=False),
+            guard_site="cg.step" if self.is_graph else "mln.step",
+            hits_site="dp.fit", extra_allowed=1)
 
     # -- optimizer-state layout conversion ----------------------------------
     def _to_flat_opt(self, e: _Entry, structured):
@@ -607,13 +611,11 @@ class DataParallelStep:
         ew = jnp.asarray(ew, model.dtype) if ew is not None else None
         with obs.span("dp.step"):
             (model.params, (self._opt_flat, self._residual), model.state,
-             _, loss) = self._step(
+             _, loss) = self._step.dispatch(
                 model.params, (self._opt_flat, self._residual), model.state,
                 jnp.asarray(model.iteration, jnp.int32), model._next_rng(),
                 x, y, fm, lm, (), ew)
         model.iteration += 1
-        retrace_guard.check_if_enabled("mln.step", hits_site="dp.fit",
-                                       extra_allowed=1)
         return loss
 
     def fit_batch_graph(self, batch, ew=None):
@@ -633,11 +635,9 @@ class DataParallelStep:
         ew = jnp.asarray(ew, model.dtype) if ew is not None else None
         with obs.span("dp.step"):
             (model.params, (self._opt_flat, self._residual), model.state,
-             _, loss) = self._step(
+             _, loss) = self._step.dispatch(
                 model.params, (self._opt_flat, self._residual), model.state,
                 jnp.asarray(model.iteration, jnp.int32), model._next_rng(),
                 model._input_dict(f), l, model._mask_dict(fm), lm, {}, ew)
         model.iteration += 1
-        retrace_guard.check_if_enabled("cg.step", hits_site="dp.fit",
-                                       extra_allowed=1)
         return loss

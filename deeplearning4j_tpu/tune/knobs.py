@@ -97,6 +97,30 @@ class Knob:
         )
 
 
+def _mesh_axis_domain() -> Tuple[int, ...]:
+    """Finite per-axis domain for the mesh-shape knobs: 0 (= auto) plus the
+    powers of two up to the local device count. Uses the already-initialized
+    jax backend when available; otherwise assumes the 8-device dev mesh
+    (tools/bench_smoke.sh, tests/conftest.py) — never imports jax here, and
+    never touches a merely-imported jax whose backend hasn't been created
+    (device_count() would initialize it), since knob registration must not
+    force backend init before the bench harness sets its platform env."""
+    import sys
+
+    n = 8
+    xb = sys.modules.get("jax._src.xla_bridge")
+    if "jax" in sys.modules and xb is not None and getattr(xb, "_backends", None):
+        try:
+            n = sys.modules["jax"].local_device_count()
+        except Exception:
+            pass
+    dom, p = [0], 1
+    while p <= n:
+        dom.append(p)
+        p *= 2
+    return tuple(dom)
+
+
 KNOBS: Tuple[Knob, ...] = (
     Knob(
         name="bucket_min", env="DL4J_TPU_BUCKET_MIN", kind="int",
@@ -138,6 +162,26 @@ KNOBS: Tuple[Knob, ...] = (
         domain=(1, 2, 4, 8), default=1, scope="fit",
         help="gradient-accumulation micro-batches per optimizer step "
              "(lax.scan inside the donated step; 1/A activation footprint)",
+    ),
+    Knob(
+        name="mesh_data", env="DL4J_TPU_MESH_DATA", kind="int",
+        domain=_mesh_axis_domain(), default=0, scope="fit",
+        help="mesh data-axis size for the named-mesh step "
+             "(parallel/mesh_step.py; 0 = auto: all devices left over after "
+             "the model/pipe axes)",
+    ),
+    Knob(
+        name="mesh_model", env="DL4J_TPU_MESH_MODEL", kind="int",
+        domain=_mesh_axis_domain(), default=0, scope="fit",
+        help="mesh tensor-parallel axis size (Megatron TP rules, "
+             "parallel/tp.py; 0 = 1 = off)",
+    ),
+    Knob(
+        name="mesh_pipe", env="DL4J_TPU_MESH_PIPE", kind="int",
+        domain=_mesh_axis_domain(), default=0, scope="fit",
+        help="mesh stage-axis size: carries the cross-replica sharded "
+             "weight update in the unified step (arXiv 2004.13336) and the "
+             "gpipe stage compute (0 = 1 = off)",
     ),
     Knob(
         name="kv_page_tokens", env="DL4J_TPU_KV_PAGE_TOKENS", kind="int",

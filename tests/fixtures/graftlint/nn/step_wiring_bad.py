@@ -1,0 +1,32 @@
+"""graftlint fixture: step-wiring true positives.
+
+Lives under a ``nn/`` subdirectory on purpose — the rule only patrols
+``nn/``/``parallel/`` paths, where hand-rolled donated-carry jits fork the
+StepProgram policy (ISSUE 13).
+"""
+
+import jax
+
+
+def _body(params, opt_state, state, x):
+    return params, opt_state, state, x.sum()
+
+
+def make_step():
+    # BAD: donated-carry jit outside nn/step_program.py
+    return jax.jit(_body, donate_argnums=(0, 1, 2))
+
+
+def make_step_kw():
+    # BAD: same, with static_argnums alongside
+    return jax.jit(_body, donate_argnums=(0,), static_argnums=(3,))
+
+
+def make_output():
+    # OK: no donated carry — not a step executable
+    return jax.jit(_body)
+
+
+def make_step_suppressed():
+    # OK: explicit opt-out with rationale
+    return jax.jit(_body, donate_argnums=(0, 1, 2))  # graftlint: disable=step-wiring

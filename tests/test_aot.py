@@ -553,9 +553,12 @@ class TestDispatcher:
         assert "mln.output" in m._aot_fns
 
     def test_unwarmed_wrapper_is_passthrough(self):
+        from deeplearning4j_tpu.nn.step_program import StepProgram
+
         m = _mln()
         step = m._get_step_fn(False)
-        assert isinstance(step, aot.AotFunction)
+        assert isinstance(step, StepProgram)
+        assert isinstance(step._fn, aot.AotFunction)
         assert step.compiled_count == 0
         m.fit(_data(8), epochs=1)  # dispatches through the lazy jit
         assert bucketing.telemetry().compiles("mln.step") == 1

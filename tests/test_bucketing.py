@@ -387,10 +387,12 @@ class TestSatellites:
         import warnings
 
         from deeplearning4j_tpu.nn import model as model_mod
+        from deeplearning4j_tpu.nn import step_program
 
         assert model_mod.CHAIN_AUTO_PARAM_LIMIT == 2_000_000
         monkeypatch.setenv("DL4J_TPU_CHAIN_STEPS", "4")
-        monkeypatch.setattr(model_mod, "_CHAIN_RNG_WARNED", False)
+        # the warn-once flag lives in the unified step-program module now
+        monkeypatch.setattr(step_program, "_CHAIN_RNG_WARNED", False)
         with warnings.catch_warnings(record=True) as w:
             warnings.simplefilter("always")
             assert model_mod._chain_k_from_env(True, 1000) == 4

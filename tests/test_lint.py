@@ -134,6 +134,14 @@ class TestRuleTruePositives:
         # consulting the DB (maybe_apply) on the dispatch path stays legal
         assert not _hits(fs, rule, "tuner_bad.py", "fit_ok")
 
+    def test_step_wiring(self, fixture_findings):
+        fs = fixture_findings
+        rule = "step-wiring"
+        assert _hits(fs, rule, "step_wiring_bad.py", "make_step")
+        assert _hits(fs, rule, "step_wiring_bad.py", "make_step_kw")
+        # a non-donating jit is not a step executable — stays allowed
+        assert not _hits(fs, rule, "step_wiring_bad.py", "make_output")
+
     def test_inline_suppressions(self, fixture_findings):
         fs = fixture_findings
         for rule, filename, func in (
@@ -146,6 +154,7 @@ class TestRuleTruePositives:
             ("cost-analysis-off-hot-path", "cost_analysis_bad.py",
              "step_suppressed"),
             ("tuner-off-hot-path", "tuner_bad.py", "fit_suppressed"),
+            ("step-wiring", "step_wiring_bad.py", "make_step_suppressed"),
         ):
             assert not _hits(fs, rule, filename, func), (rule, func)
 

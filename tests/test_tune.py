@@ -420,9 +420,10 @@ class TestGradAccumParity:
             np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)
 
     def test_non_divisible_batch_falls_back_with_warning(self, monkeypatch):
-        import deeplearning4j_tpu.nn.model as model_mod
+        from deeplearning4j_tpu.nn import step_program
 
-        monkeypatch.setattr(model_mod, "_GRAD_ACCUM_WARNED", False)
+        # the warn-once flag lives in the unified step-program module now
+        monkeypatch.setattr(step_program, "_GRAD_ACCUM_WARNED", False)
         monkeypatch.setenv("DL4J_TPU_GRAD_ACCUM", "5")
         data = _data(n=32)  # 32 % 5 != 0
         with warnings.catch_warnings(record=True) as caught:

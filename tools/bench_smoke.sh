@@ -89,3 +89,35 @@ if not (tuner.get("skipped") or tuner.get("gate_tuned_ge_default")):
 print(f"bench smoke OK: tuned mnist_mlp within budget, tuner arm "
       f"{'skipped (budget)' if tuner.get('skipped') else 'gate held'}")
 EOF
+
+# one-mesh gates (docs/PARALLELISM.md): mesh_mfu on the forced 8-device CPU
+# mesh must hold all three — best (d,t,s) >= pure-DP, cross-shape loss
+# parity, and zero mln.step re-traces in every arm's measured loop. The
+# in-process smoke pass above ran it single-device; --only applies the
+# virtual mesh env (bench._CPU_MESH_BENCHES) before jax initializes.
+out3=$(mktemp)
+trap 'rm -f "$out" "$out2" "$out3"' EXIT
+timeout -k 10 "$((budget * 3 + 300))" env BENCH_SMOKE=1 \
+    JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python bench.py --only mesh_mfu \
+    | tee "$out3"
+python - "$out3" <<'EOF'
+import json
+import sys
+
+with open(sys.argv[1]) as f:
+    m = json.loads(f.read().strip().splitlines()[-1])
+if m.get("metric") != "mesh_step_tuned_vs_dp" or "error" in m:
+    sys.exit(f"bench smoke: mesh_mfu failed: "
+             f"{ {k: v for k, v in m.items() if k != 'obs'} }")
+if m.get("devices", 0) < 8:
+    sys.exit(f"bench smoke: mesh_mfu saw {m.get('devices')} devices, "
+             f"expected the forced 8-device mesh")
+for gate in ("gate_tuned_ge_dp_baseline", "gate_shape_parity",
+             "gate_zero_steady_state_compiles"):
+    if not m.get(gate):
+        sys.exit(f"bench smoke: mesh_mfu {gate} failed: "
+                 f"{ {k: v for k, v in m.items() if k != 'obs'} }")
+print(f"bench smoke OK: mesh gates held — tuned {m['tuned_shape']} at "
+      f"{m['value']}x pure-DP, parity dev {m['parity_max_rel_dev']}, "
+      f"0 steady-state retraces")
+EOF
