@@ -1588,6 +1588,141 @@ def bench_cold_start():
     return result
 
 
+def bench_vector_search():
+    """ANN search-tier acceptance probe (search tentpole): a 100k x 64
+    clustered corpus served by BOTH tiers of one :class:`VectorIndex` out
+    of a COLD bundle-restored process. The build phase (fresh subprocess)
+    trains the IVF coarse quantizer, warms the bucket-ladder grid and
+    persists index + executable bundle; the measure phase (second fresh
+    subprocess, compile cache empty) loads, restores, warms (all cache
+    hits) and times single-query requests per tier — so the reported
+    ``request_path_compiles`` is the real cold-process zero-compile gate,
+    not an in-process approximation.
+
+    Gates (asserted by tools/bench_smoke.sh):
+      - corpus >= 100k vectors,
+      - recall@10 of the IVF tier vs the exact tier >= 0.9,
+      - IVF p99 strictly below exact-scan p99,
+      - ZERO request-path compiles in the cold restored process.
+    """
+    import shutil
+    import subprocess
+    import tempfile
+    import textwrap
+
+    corpus_n, dim, n_centers = 100_000, 64, 256
+    nlist, nprobe = 256, 8
+    n_queries = 50 if SMOKE else 200
+    timeout = (3 * _BUDGET_S + 300) if _BUDGET_S > 0 else 900
+    workdir = tempfile.mkdtemp(prefix="bench_vecsearch_")
+
+    # both phases regenerate the identical corpus/queries from the seed —
+    # cheaper than shipping a 25MB npz and keeps each phase self-contained
+    script = textwrap.dedent("""
+        import json, os, sys, time
+        import numpy as np
+        os.environ["DL4J_TPU_AOT_BUNDLE"] = "1"
+        from deeplearning4j_tpu.nn import aot
+        from deeplearning4j_tpu.search import IndexConfig, VectorIndex
+
+        phase, d = sys.argv[1], sys.argv[2]
+        corpus_n, dim, n_centers = (int(a) for a in sys.argv[3:6])
+        nlist, nprobe, n_q = (int(a) for a in sys.argv[6:9])
+        ipath = os.path.join(d, "ix.zip")
+        bpath = os.path.join(d, "ix.aotbundle")
+        rs = np.random.RandomState(42)
+        centers = (4.0 * rs.randn(n_centers, dim)).astype(np.float32)
+        corpus = (centers[rs.randint(0, n_centers, corpus_n)]
+                  + rs.randn(corpus_n, dim)).astype(np.float32)
+        queries = (centers[rs.randint(0, n_centers, n_q)]
+                   + rs.randn(n_q, dim)).astype(np.float32)
+        if phase == "build":
+            t0 = time.perf_counter()
+            ix = VectorIndex.build(corpus, IndexConfig(
+                dim=dim, nlist=nlist, nprobe=nprobe, max_k=16,
+                batch_max=1, k_choices=(16,), train_sample=20000,
+                kmeans_iters=8, pending_cap=0))
+            build_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            warmed = ix.warm()
+            warm_s = time.perf_counter() - t0
+            aot.save_bundle(ix, bpath)
+            ix.save(ipath)
+            print(json.dumps({"build_s": round(build_s, 2),
+                              "warm_s": round(warm_s, 2),
+                              "warmed_executables": int(warmed)}))
+        else:
+            ix = VectorIndex.load(ipath)
+            restored = aot.restore_bundle(ix, bpath)
+            ix.warm()            # restored grid -> every rung a cache hit
+            c0 = ix.program.compiles_observed()
+            lat = {"exact": [], "ivf": []}
+            ids = {"exact": [], "ivf": []}
+            for tier in ("exact", "ivf"):
+                for i in range(n_q):
+                    q = queries[i:i + 1]
+                    t0 = time.perf_counter()
+                    got, _ = ix.search(q, k=10, tier=tier)
+                    lat[tier].append((time.perf_counter() - t0) * 1e3)
+                    ids[tier].append(np.asarray(got[0]))
+            recall = float(np.mean([
+                np.intersect1d(a[a >= 0], b[b >= 0]).size / 10.0
+                for a, b in zip(ids["ivf"], ids["exact"])]))
+            out = {"restored_executables": int(restored),
+                   "request_path_compiles":
+                       int(ix.program.compiles_observed() - c0),
+                   "recall_at_10": round(recall, 4)}
+            for tier in ("exact", "ivf"):
+                a = np.asarray(lat[tier])
+                out[tier + "_p50_ms"] = round(float(np.percentile(a, 50)), 3)
+                out[tier + "_p99_ms"] = round(float(np.percentile(a, 99)), 3)
+                out[tier + "_qps"] = round(n_q / (a.sum() / 1e3), 1)
+            print(json.dumps(out))
+    """)
+
+    def run_phase(phase: str) -> dict:
+        argv = [sys.executable, "-c", script, phase, workdir,
+                str(corpus_n), str(dim), str(n_centers),
+                str(nlist), str(nprobe), str(n_queries)]
+        try:
+            proc = subprocess.run(
+                argv, capture_output=True, text=True, timeout=timeout,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+        except subprocess.SubprocessError as e:
+            return {"error": f"{phase}: {type(e).__name__}: {e}"[:300]}
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                obj = json.loads(line)
+            except (json.JSONDecodeError, ValueError):
+                continue
+            if isinstance(obj, dict):
+                return obj
+        return {"error": f"{phase}: rc={proc.returncode}: "
+                         f"{proc.stderr[-300:]}"}
+
+    try:
+        build = run_phase("build")
+        serve = {} if "error" in build else run_phase("serve")
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    result = {
+        "metric": "vector_search_p99",
+        "unit": "ms per single-query request, IVF tier, cold "
+                "bundle-restored process",
+        "corpus": corpus_n, "dim": dim, "queries_per_tier": n_queries,
+        "nlist": nlist, "nprobe": nprobe,
+    }
+    result.update(build)
+    result.update(serve)
+    if "error" in result:
+        return result
+    result["value"] = result["ivf_p99_ms"]
+    result["ivf_p99_speedup_vs_exact"] = round(
+        result["exact_p99_ms"] / max(result["ivf_p99_ms"], 1e-3), 2)
+    return result
+
+
 _BENCHES = {
     "lenet5": bench_lenet5,
     "resnet50": bench_resnet50,
@@ -1602,6 +1737,7 @@ _BENCHES = {
     "checkpoint": bench_checkpoint,
     "mnist_mlp": bench_mnist_mlp,
     "cold_start": bench_cold_start,
+    "vector_search": bench_vector_search,
 }
 
 # benches that need a multi-device mesh regardless of the host's accelerator

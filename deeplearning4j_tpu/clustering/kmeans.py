@@ -6,19 +6,27 @@ maxIterationCount, distanceFunction) -> applyTo(points) -> ClusterSet) —
 re-designed TPU-first: the whole assignment+update iteration is ONE jitted
 program (distance matrix on the MXU, segment-sum centroid update), instead
 of the reference's per-point Java loops over Cluster objects.
+
+Both jitted sites here are shape-bucketed (``utils/bucketing``): the point
+count is padded up the shared ladder and carried as a *dynamic* validity
+scalar, so IVF index builds (``search/index.py``) that sweep corpus sizes
+reuse a handful of executables instead of retracing per size. Compiles are
+recorded through ``bucketing.record_trace`` ("kmeans.lloyd" /
+"kmeans.assign") so the retrace guard and bench snapshots see index builds.
 """
 
 from __future__ import annotations
 
 import functools
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.clustering.knn import pairwise_distance
+from deeplearning4j_tpu.utils import bucketing
 
 
 @dataclass
@@ -58,13 +66,18 @@ class ClusterSet:
 
 
 @functools.partial(jax.jit, static_argnames=("metric",))
-def _lloyd_step(points, centers, metric):
+def _lloyd_step(points, centers, n_valid, metric):
     """One Lloyd iteration: assign + recompute. Empty clusters keep their
-    previous center (reference keeps the cluster alive too)."""
+    previous center (reference keeps the cluster alive too). Rows at or past
+    ``n_valid`` are bucket padding: they still get an argmin assignment (the
+    caller slices them off) but a validity mask zeroes them out of the
+    centroid sums, so the padded update equals the unpadded one exactly."""
+    bucketing.telemetry().record_trace("kmeans.lloyd", points.shape)
     d = pairwise_distance(points, centers, metric)
     assign = jnp.argmin(d, axis=1)
     k = centers.shape[0]
-    one_hot = jax.nn.one_hot(assign, k, dtype=points.dtype)      # [n, k]
+    valid = (jnp.arange(points.shape[0]) < n_valid).astype(points.dtype)
+    one_hot = jax.nn.one_hot(assign, k, dtype=points.dtype) * valid[:, None]
     counts = jnp.sum(one_hot, axis=0)                            # [k]
     sums = one_hot.T @ points                                    # [k, d]
     new_centers = jnp.where(
@@ -73,6 +86,47 @@ def _lloyd_step(points, centers, metric):
     shift = jnp.max(jnp.linalg.norm(new_centers - centers, axis=1))
     mind = jnp.min(d, axis=1)
     return new_centers, assign, mind, shift
+
+
+@functools.partial(jax.jit, static_argnames=("metric",))
+def _assign_step(points, centers, metric):
+    """Assignment-only site: nearest center id + distance per row. Row
+    independent, so bucket padding needs no mask — padded rows are dead
+    compute sliced off by the caller."""
+    bucketing.telemetry().record_trace("kmeans.assign", points.shape)
+    d = pairwise_distance(points, centers, metric)
+    return jnp.argmin(d, axis=1), jnp.min(d, axis=1)
+
+
+def assign_points(points, centers, metric: str = "euclidean",
+                  chunk_rows: int = 16384) -> Tuple[np.ndarray, np.ndarray]:
+    """Nearest-center assignment for a full corpus, chunked and bucketed.
+
+    Chunking caps the [rows, k] distance matrix (16384×512 f32 ≈ 32 MB);
+    each chunk's leading axis is padded up the shared ladder so corpus-size
+    sweeps during IVF builds hit a handful of "kmeans.assign" executables.
+    Returns ``(assign, distance)`` as host arrays of length ``len(points)``.
+    """
+    points = np.asarray(points, np.float32)
+    # host-side API: callers (IVF build, ClusterSet) consume numpy — the
+    # pulls below are the contract, not accidental syncs
+    centers = jnp.asarray(np.asarray(centers, np.float32))  # graftlint: disable=host-sync
+    n = points.shape[0]
+    ladder = bucketing.ladder_from_env()
+    tel = bucketing.telemetry()
+    assigns, dists = [], []
+    for lo in range(0, n, chunk_rows):
+        chunk = points[lo:lo + chunk_rows]
+        rows = chunk.shape[0]
+        target = ladder.bucket(rows) if bucketing.bucketing_enabled() else rows
+        tel.record_hit("kmeans.assign", rows, target)
+        padded = bucketing.pad_rows_zero(chunk, target)
+        a, d = _assign_step(jnp.asarray(padded), centers, metric)
+        assigns.append(np.asarray(a[:rows]))  # graftlint: disable=host-sync
+        dists.append(np.asarray(d[:rows]))  # graftlint: disable=host-sync
+    if not assigns:
+        return np.zeros(0, np.int32), np.zeros(0, np.float32)
+    return np.concatenate(assigns), np.concatenate(dists)
 
 
 def _kmeanspp_init(points: np.ndarray, k: int, metric: str, rs: np.random.RandomState):
@@ -92,22 +146,33 @@ def _kmeanspp_init(points: np.ndarray, k: int, metric: str, rs: np.random.Random
     return np.stack(centers)
 
 
+def _random_init(points: np.ndarray, k: int, rs: np.random.RandomState):
+    """Random distinct-row seeding (the reference's own strategy). O(k) vs
+    k-means++'s O(n·k²) distance work — the right trade for IVF coarse
+    quantizers where k is large and Lloyd refines anyway."""
+    idx = rs.choice(points.shape[0], size=k, replace=False)
+    return points[idx].copy()
+
+
 class KMeansClustering:
     """``KMeansClustering.setup(k, max_iters, distance_fn)`` then
     ``apply_to(points)`` (reference KMeansClustering.java:52)."""
 
     def __init__(self, cluster_count: int, max_iteration_count: int = 100,
                  distance_function: str = "euclidean", tolerance: float = 1e-4,
-                 seed: int = 12345):
+                 seed: int = 12345, init: str = "kmeanspp"):
         if distance_function.lower() in ("cosinesimilarity", "dot"):
             raise ValueError(
                 "k-means needs a distance (smaller=closer); use 'cosinedistance'"
             )
+        if init not in ("kmeanspp", "random"):
+            raise ValueError(f"init must be 'kmeanspp' or 'random', got {init!r}")
         self.k = int(cluster_count)
         self.max_iterations = int(max_iteration_count)
         self.distance_function = distance_function
         self.tolerance = float(tolerance)
         self.seed = seed
+        self.init = init
 
     @staticmethod
     def setup(cluster_count: int, max_iteration_count: int = 100,
@@ -117,19 +182,31 @@ class KMeansClustering:
 
     def apply_to(self, points) -> ClusterSet:
         points = np.asarray(points, np.float32)
-        if points.shape[0] < self.k:
-            raise ValueError(f"need >= {self.k} points, got {points.shape[0]}")
+        n = points.shape[0]
+        if n < self.k:
+            raise ValueError(f"need >= {self.k} points, got {n}")
         rs = np.random.RandomState(self.seed)
-        centers = jnp.asarray(_kmeanspp_init(points, self.k, self.distance_function, rs))
-        pts = jnp.asarray(points)
+        if self.init == "random":
+            centers = jnp.asarray(_random_init(points, self.k, rs))
+        else:
+            centers = jnp.asarray(
+                _kmeanspp_init(points, self.k, self.distance_function, rs))
+        ladder = bucketing.ladder_from_env()
+        target = ladder.bucket(n) if bucketing.bucketing_enabled() else n
+        bucketing.telemetry().record_hit("kmeans.lloyd", n, target)
+        pts = jnp.asarray(bucketing.pad_rows_zero(points, target))
+        n_valid = jnp.int32(n)
         assign = mind = None
         for _ in range(self.max_iterations):
-            centers, assign, mind, shift = _lloyd_step(pts, centers, self.distance_function)
+            centers, assign, mind, shift = _lloyd_step(
+                pts, centers, n_valid, self.distance_function)
             if float(shift) < self.tolerance:
                 break
+        # ClusterSet is a host-side result object — pulling once at the end
+        # of the fit is the API, not a hot-path sync
         return ClusterSet(
             centers=np.asarray(centers),
-            assignments=np.asarray(assign),
-            distances=np.asarray(mind),
+            assignments=np.asarray(assign[:n]),  # graftlint: disable=host-sync
+            distances=np.asarray(mind[:n]),  # graftlint: disable=host-sync
             distance_function=self.distance_function,
         )

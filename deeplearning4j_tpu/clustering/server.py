@@ -1,10 +1,20 @@
-"""Nearest-neighbors HTTP server.
+"""Nearest-neighbors HTTP server — DEPRECATED shim over the unified stack.
 
-Capability parity with the reference's nearestneighbor-server
-(NearestNeighborsServer: POST /knn for an already-indexed row, POST /knnnew
-for a raw vector; JSON request/response DTOs). Stdlib ThreadingHTTPServer —
-no framework dependency; the search itself is the jitted batched top-k
-(clustering/knn.py), so concurrent requests share one compiled kernel.
+The standalone ThreadingHTTPServer this module used to carry is retired:
+the ``/knn`` / ``/knnnew`` / ``/status`` wire contract now lives on the
+unified inference server (``serve/server.py``), so there is ONE HTTP
+stack, one SLO tracker and one ``/metrics`` endpoint for predict,
+generate and search alike. :class:`NearestNeighborsServer` survives as a
+thin compatibility shim: same constructor, same ``start(port)`` /
+``stop()`` / ``.port`` surface, same JSON responses — but ``start`` now
+builds an exact-tier :class:`~deeplearning4j_tpu.search.index.VectorIndex`
+and serves it through :class:`~deeplearning4j_tpu.serve.InferenceServer`.
+Prefer ``serve.ModelRegistry().register_index(...)`` +
+``POST /v1/search`` for new code (docs/SEARCH.md).
+
+Metrics the device index does not speak (sqeuclidean / manhattan / dot /
+inverted similarity) fall back to the legacy in-module server so the old
+CLI keeps answering; that path warns and will be removed with the shim.
 
 POST /knn     {"ndarray": <row index>, "k": 5}
 POST /knnnew  {"ndarray": [..vector..], "k": 5}
@@ -16,6 +26,7 @@ from __future__ import annotations
 
 import json
 import threading
+import warnings
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -23,10 +34,76 @@ import numpy as np
 
 from deeplearning4j_tpu.clustering.knn import knn_search
 
+# legacy similarity name -> VectorIndex metric; anything absent here (or
+# invert=True) cannot be expressed by the device index and stays legacy
+_UNIFIED_METRICS = {
+    "euclidean": "euclidean",
+    "cosine": "cosine",
+    "cosinedistance": "cosine",
+}
+
 
 class NearestNeighborsServer:
     """``NearestNeighborsServer(points, similarity_function).start(port)``;
-    ``stop()`` to shut down. Port 0 picks a free port (see ``.port``)."""
+    ``stop()`` to shut down. Port 0 picks a free port (see ``.port``).
+
+    Deprecated: a compatibility front for the unified serving stack — see
+    the module docstring."""
+
+    def __init__(self, points, similarity_function: str = "euclidean",
+                 invert: bool = False):
+        warnings.warn(
+            "clustering.server.NearestNeighborsServer is deprecated: the "
+            "/knn routes now live on the unified inference server — use "
+            "serve.ModelRegistry().register_index(...) and POST /v1/search "
+            "(docs/SEARCH.md)", DeprecationWarning, stacklevel=2)
+        self.points = np.asarray(points, np.float32)
+        self.similarity_function = similarity_function
+        self.invert = invert
+        self._srv = None          # unified InferenceServer
+        self._legacy: Optional[_LegacyNearestNeighborsServer] = None
+        self.port: Optional[int] = None
+
+    def start(self, port: int = 9000) -> "NearestNeighborsServer":
+        metric = _UNIFIED_METRICS.get(self.similarity_function.lower())
+        if metric is None or self.invert:
+            warnings.warn(
+                f"similarity {self.similarity_function!r} (invert="
+                f"{self.invert}) is not served by the device index; "
+                "falling back to the legacy brute-force server",
+                DeprecationWarning, stacklevel=2)
+            self._legacy = _LegacyNearestNeighborsServer(
+                self.points, self.similarity_function, self.invert
+            ).start(port)
+            self.port = self._legacy.port
+            return self
+        from deeplearning4j_tpu.search import IndexConfig, VectorIndex
+        from deeplearning4j_tpu.serve import InferenceServer, ModelRegistry
+
+        index = VectorIndex.build(self.points, IndexConfig(
+            dim=int(self.points.shape[1]), name="default", metric=metric,
+            ivf=False, pending_cap=0, max_k=64))
+        registry = ModelRegistry()
+        # compat shim favors startup latency over first-request latency:
+        # the exact tier lazy-compiles one executable per reached bucket
+        registry.register_index("default", index, warm=False)
+        self._srv = InferenceServer(registry).start(port=port)
+        self.port = self._srv.port
+        return self
+
+    def stop(self) -> None:
+        if self._srv is not None:
+            self._srv.stop()
+            self._srv = None
+        if self._legacy is not None:
+            self._legacy.stop()
+            self._legacy = None
+
+
+class _LegacyNearestNeighborsServer:
+    """The pre-unification stdlib server, kept verbatim for the metric
+    combinations the device index does not express. Scheduled for removal
+    with the shim."""
 
     def __init__(self, points, similarity_function: str = "euclidean",
                  invert: bool = False):
@@ -45,7 +122,7 @@ class NearestNeighborsServer:
             for i, d in zip(idx[0], dist[0])
         ]
 
-    def start(self, port: int = 9000) -> "NearestNeighborsServer":
+    def start(self, port: int = 9000) -> "_LegacyNearestNeighborsServer":
         outer = self
 
         class Handler(BaseHTTPRequestHandler):

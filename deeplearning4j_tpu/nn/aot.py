@@ -90,7 +90,7 @@ __all__ = [
     "wrap",
 ]
 
-BUNDLE_FORMAT_VERSION = 1
+BUNDLE_FORMAT_VERSION = 2
 _MANIFEST_ENTRY = "manifest.json"
 
 
@@ -138,6 +138,23 @@ def _sig_label(key: Tuple) -> str:
 # ---------------------------------------------------------------------------
 
 
+class _RestoredStaticCall:
+    """Call adapter for bundle-restored executables of static-arg sites:
+    strips the static positions from the full-signature dispatch call.
+    ``raw_compiled`` stays reachable so re-bundling serializes the real
+    executable, not this wrapper."""
+
+    __slots__ = ("raw_compiled", "_statics")
+
+    def __init__(self, compiled, statics):
+        self.raw_compiled = compiled
+        self._statics = frozenset(statics)
+
+    def __call__(self, *args, **kwargs):
+        dyn = tuple(a for i, a in enumerate(args) if i not in self._statics)
+        return self.raw_compiled(*dyn, **kwargs)
+
+
 class AotFunction:
     """A jitted function plus a cache of AOT-compiled executables.
 
@@ -148,11 +165,24 @@ class AotFunction:
     everything else falls through to the lazy jit. The fast path for
     un-warmed functions is a single truthiness check on an empty dict."""
 
-    def __init__(self, jitted, site: str):
+    def __init__(self, jitted, site: str,
+                 static_argnums: Optional[Tuple[int, ...]] = None):
         self._jit = jitted
         self.site = site
+        self._static_argnums = tuple(static_argnums or ())
         self._compiled: Dict[Tuple, Any] = {}
         self._lock = threading.Lock()
+
+    def _key(self, args: tuple, kwargs: dict) -> Tuple:
+        """Dispatch key. ``signature_key`` sees only shape/dtype, under
+        which all python-int static args collide (k=1 and k=16 both read as
+        a 0-d int leaf) — but jit keys statics by VALUE, so the AOT cache
+        must too or warming k=1 silently shadows every other k."""
+        key = signature_key(args, kwargs)
+        if self._static_argnums:
+            key = key + (tuple(args[i] for i in self._static_argnums
+                               if i < len(args)),)
+        return key
 
     # -- warmup ------------------------------------------------------------
     def warm(self, *args, cost_key: Optional[str] = None, **kwargs):
@@ -160,7 +190,7 @@ class AotFunction:
         cache the executable; returns the ``Compiled`` (idempotent).
         ``cost_key`` labels the executable's cost-model gauges (warmers pass
         the bucket, e.g. ``b64``; defaults to a signature hash)."""
-        key = signature_key(args, kwargs)
+        key = self._key(args, kwargs)
         existing = self._compiled.get(key)
         if existing is not None:
             return existing
@@ -175,9 +205,16 @@ class AotFunction:
 
     def install(self, key: Tuple, compiled) -> None:
         """Adopt an already-built executable (bundle restore path)."""
+        raw = compiled
+        if self._static_argnums:
+            # a deserialized executable takes DYNAMIC args only (the
+            # serialized in_tree drops static_argnums), while a fresh
+            # lower().compile() object takes the full signature — adapt so
+            # dispatch stays uniform
+            compiled = _RestoredStaticCall(raw, self._static_argnums)
         with self._lock:
             self._compiled[key] = compiled
-        _profile.harvest_compiled(self.site, compiled, key=_sig_label(key))
+        _profile.harvest_compiled(self.site, raw, key=_sig_label(key))
 
     @property
     def compiled_count(self) -> int:
@@ -189,7 +226,7 @@ class AotFunction:
     # -- dispatch ----------------------------------------------------------
     def __call__(self, *args, **kwargs):
         if self._compiled:
-            key = signature_key(args, kwargs)
+            key = self._key(args, kwargs)
             compiled = self._compiled.get(key)
             if compiled is not None:
                 try:
@@ -227,12 +264,15 @@ class AotFunction:
         return self._jit.lower(*args, **kwargs)
 
 
-def wrap(jitted, site: str, model=None) -> AotFunction:
+def wrap(jitted, site: str, model=None,
+         static_argnums: Optional[Tuple[int, ...]] = None) -> AotFunction:
     """Wrap a jitted entry point for AOT dispatch and register it on the
     model's AOT function registry (``model._aot_fns``). Executables restored
     from a bundle before the function existed (``restore_bundle`` on a fresh
-    model) are waiting in ``model._aot_pending`` and are adopted here."""
-    fn = AotFunction(jitted, site)
+    model) are waiting in ``model._aot_pending`` and are adopted here.
+    ``static_argnums`` must mirror the jit's own, so dispatch keys carry the
+    static VALUES exactly like jit's cache does."""
+    fn = AotFunction(jitted, site, static_argnums=static_argnums)
     if model is not None:
         reg = model.__dict__.setdefault("_aot_fns", {})
         reg[site] = fn
@@ -690,7 +730,8 @@ def save_bundle(model, path) -> Optional[dict]:
                 if compiled is None:
                     continue
                 try:
-                    payload, in_tree, out_tree = jse.serialize(compiled)
+                    payload, in_tree, out_tree = jse.serialize(
+                        getattr(compiled, "raw_compiled", compiled))
                 except Exception:
                     # backend refuses to serialize this executable: skip it,
                     # the rest of the bundle is still worth shipping

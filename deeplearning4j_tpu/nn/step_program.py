@@ -98,7 +98,9 @@ class StepProgram:
             kwargs["static_argnums"] = tuple(static_argnums)
         jitted = jax.jit(fn, **kwargs)
         self._aot = bool(aot_wrap)
-        self._fn = aot.wrap(jitted, site, model=model) if aot_wrap else jitted
+        self._fn = (aot.wrap(jitted, site, model=model,
+                             static_argnums=kwargs.get("static_argnums"))
+                    if aot_wrap else jitted)
 
     # -- dispatch ----------------------------------------------------------
     def __call__(self, *args, **kwargs):

@@ -21,7 +21,10 @@ per route:
   watches for latency SLO violations.
 
 Knobs (read at tracker construction): ``DL4J_TPU_SLO_LATENCY_MS`` (latency
-threshold, default 250), ``DL4J_TPU_SLO_OBJECTIVE`` (good-request
+threshold, default 250), ``DL4J_TPU_SLO_ROUTE_LATENCY_MS`` (per-route
+overrides as comma-separated ``prefix=ms`` pairs, longest matching prefix
+wins — e.g. ``search:http=50,generate=2000`` holds search to 50ms while
+generation keeps a 2s envelope), ``DL4J_TPU_SLO_OBJECTIVE`` (good-request
 objective, default 0.99), ``DL4J_TPU_SLO_WINDOW_S`` (sliding window,
 default 300).
 
@@ -48,6 +51,22 @@ __all__ = ["SloTracker", "slo_tracker", "observe_request", "observe_shed",
            "observe_ttft", "observe_itl", "set_decode_occupancy"]
 
 
+def _parse_route_thresholds(spec: str) -> Dict[str, float]:
+    """``"search:http=50,generate=2000"`` -> {prefix: seconds}. Malformed
+    pairs are skipped — a bad knob value must not take down the tracker."""
+    out: Dict[str, float] = {}
+    for pair in spec.split(","):
+        pair = pair.strip()
+        if not pair or "=" not in pair:
+            continue
+        prefix, _, ms = pair.rpartition("=")
+        try:
+            out[prefix.strip()] = float(ms) / 1e3
+        except ValueError:
+            continue
+    return out
+
+
 class SloTracker:
     def __init__(self,
                  reg: Optional[metrics.MetricsRegistry] = None,
@@ -63,6 +82,8 @@ class SloTracker:
         if window_s is None:
             window_s = float(env("DL4J_TPU_SLO_WINDOW_S", "300"))
         self.threshold_s = threshold_s
+        self.route_thresholds_s = _parse_route_thresholds(
+            env("DL4J_TPU_SLO_ROUTE_LATENCY_MS", ""))
         self.objective = min(max(objective, 0.0), 0.999999)
         self.window_s = window_s
         self._hist = self._reg.histogram(
@@ -111,6 +132,20 @@ class SloTracker:
         # route -> deque[(perf_counter_ts, is_bad)]
         self._windows: Dict[str, Deque[Tuple[float, bool]]] = {}
 
+    def threshold_for(self, route: str) -> float:
+        """Latency threshold for ``route``: the longest
+        ``DL4J_TPU_SLO_ROUTE_LATENCY_MS`` prefix that matches, else the
+        global default. Different request classes carry different latency
+        contracts (a vector search answers in tens of ms, a generate stream
+        in seconds); one global number would either page on healthy
+        generation or sleep through a slow search tier."""
+        best = self.threshold_s
+        best_len = -1
+        for prefix, thr in self.route_thresholds_s.items():
+            if route.startswith(prefix) and len(prefix) > best_len:
+                best, best_len = thr, len(prefix)
+        return best
+
     def observe(self, route: str, latency_s: float, status: str = "ok",
                 error: bool = False):
         """Record one finished request. Never raises (the serving path must
@@ -118,7 +153,8 @@ class SloTracker:
         try:
             self._hist.observe(latency_s, route=route)
             self._count.inc(route=route, status=status)
-            self._note_window(route, error or latency_s > self.threshold_s)
+            self._note_window(
+                route, error or latency_s > self.threshold_for(route))
         except Exception:
             pass
 

@@ -1,0 +1,19 @@
+"""Device-resident ANN search tier (docs/SEARCH.md).
+
+The retrieval layer of the port (reference: VPTree/KD-tree/LSH + the
+NearestNeighborsServer, PAPER.md layer 6), rebuilt accelerator-first:
+instead of pointer-chasing tree structures, three matmul-shaped scoring
+tiers (exact / IVF / IVF-PQ) over a fixed-shape device corpus, compiled
+once per bucket rung and served through the same admission/SLO machinery
+as every other route (``serve/``).
+"""
+
+from deeplearning4j_tpu.search.index import IndexConfig, VectorIndex
+from deeplearning4j_tpu.search.program import (
+    SITE_EXACT, SITE_IVF, SITE_MERGE, SITE_PQ, SearchProgram,
+)
+
+__all__ = [
+    "IndexConfig", "SITE_EXACT", "SITE_IVF", "SITE_MERGE", "SITE_PQ",
+    "SearchProgram", "VectorIndex",
+]

@@ -1,0 +1,239 @@
+"""Compiled kernels of the device-resident search tiers.
+
+Three scoring tiers share one compiled-kernel discipline (docs/SEARCH.md):
+
+- **exact**  — brute-force matmul top-k over a fixed-capacity corpus chunk.
+  The squared-euclidean expansion ``||q||² - 2 q·x + ||x||²`` puts the whole
+  scan on the MXU as a single [B, C] matmul; padded corpus rows are masked
+  to -inf before ``lax.top_k``.
+- **ivf**    — coarse-quantizer assign (a tiny [B, nlist] matmul against the
+  k-means centroids) picks ``nprobe`` inverted lists per query, then a
+  ``lax.scan`` over the probed lists gathers each list's vectors and exact-
+  scores them, carrying a running top-k. Work drops from O(C) to
+  O(nprobe · L) per query.
+- **ivf_pq** — same probe loop, but candidates are scored from uint8 PQ
+  codes via an ADC lookup table (``lut[b, m, code]`` built once per batch),
+  carrying a top-``r`` candidate set that a final exact gather reranks down
+  to k. Memory touched per candidate falls from D floats to M bytes.
+
+Every body is built through :class:`nn.step_program.StepProgram` (the
+step-wiring rule: no raw ``jit(donate_argnums)``), records its compile via
+``bucketing.record_trace`` from inside the traced body, and takes its batch
+already padded onto the shared bucket ladder — so the reachable signature
+grid is finite and :meth:`SearchProgram.warm` can AOT-compile all of it
+before the first request (zero request-path compiles, the same contract the
+model-serving tier holds).
+
+Score convention: **scores are negated squared-euclidean distances**
+throughout (larger = closer), so ``lax.top_k`` works unmodified and invalid
+slots are -inf. Cosine similarity is served by L2-normalizing corpus and
+queries at build/search time (monotone-equivalent ordering); the host layer
+converts final scores back to user-facing distances.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu import obs
+from deeplearning4j_tpu.nn import aot
+from deeplearning4j_tpu.nn.step_program import StepProgram
+from deeplearning4j_tpu.utils import bucketing
+
+__all__ = [
+    "SITE_EXACT", "SITE_IVF", "SITE_MERGE", "SITE_PQ", "SearchProgram",
+]
+
+SITE_EXACT = "search.exact"
+SITE_MERGE = "search.merge"
+SITE_IVF = "search.ivf"
+SITE_PQ = "search.ivf_pq"
+
+
+def _exact_body(q, chunk, cnorms, n_valid, offset, k):
+    """[B, C] exact scores + top-k. ``n_valid`` (dynamic scalar) masks the
+    capacity padding; ids past it can never surface. ``offset`` shifts the
+    returned ids into the global id space (the pending buffer scores at
+    offset = main-corpus count so its hits merge correctly)."""
+    bucketing.telemetry().record_trace(
+        SITE_EXACT, (q.shape[0], chunk.shape[0], k))
+    qn = jnp.sum(q * q, axis=-1)
+    d = qn[:, None] - 2.0 * (q @ chunk.T) + cnorms[None, :]
+    col_ok = jnp.arange(chunk.shape[0]) < n_valid
+    scores = jnp.where(col_ok[None, :], -d, -jnp.inf)
+    best, idx = jax.lax.top_k(scores, k)
+    return best, idx.astype(jnp.int32) + offset
+
+
+def _merge_body(sa, ia, sb, ib, k):
+    """Merge two per-query top-k result sets (main corpus + pending buffer)
+    into one, preserving global id spaces carried in ``ia``/``ib``."""
+    bucketing.telemetry().record_trace(
+        SITE_MERGE, (sa.shape[0], sa.shape[1] + sb.shape[1], k))
+    s = jnp.concatenate([sa, sb], axis=1)
+    i = jnp.concatenate([ia, ib], axis=1)
+    best, sel = jax.lax.top_k(s, k)
+    return best, jnp.take_along_axis(i, sel, axis=1)
+
+
+def _ivf_body(q, centroids, postings, sizes, corpus, cnorms, nprobe, k):
+    """IVF probe loop: coarse top-nprobe lists, then a scan over the probed
+    lists carrying a running exact top-k. Returns ``(scores, ids, counts)``
+    where counts is candidates actually scored per query (the
+    dl4j_search_candidates_scanned histogram source)."""
+    B = q.shape[0]
+    L = postings.shape[1]
+    bucketing.telemetry().record_trace(SITE_IVF, (B, nprobe, k))
+    qn = jnp.sum(q * q, axis=-1)
+    centnorms = jnp.sum(centroids * centroids, axis=-1)
+    dc = qn[:, None] - 2.0 * (q @ centroids.T) + centnorms[None, :]
+    _, probe = jax.lax.top_k(-dc, nprobe)                      # [B, nprobe]
+
+    def step(carry, pid):                                      # pid: [B]
+        best, bidx, cnt = carry
+        rows = postings[pid]                                   # [B, L]
+        valid = jnp.arange(L)[None, :] < sizes[pid][:, None]
+        vecs = corpus[rows]                                    # [B, L, D]
+        dot = jnp.einsum("bd,bld->bl", q, vecs)
+        d = qn[:, None] - 2.0 * dot + cnorms[rows]
+        sc = jnp.where(valid, -d, -jnp.inf)
+        nb, sel = jax.lax.top_k(jnp.concatenate([best, sc], axis=1), k)
+        ni = jnp.take_along_axis(
+            jnp.concatenate([bidx, rows], axis=1), sel, axis=1)
+        return (nb, ni, cnt + jnp.sum(valid, axis=1)), None
+
+    init = (jnp.full((B, k), -jnp.inf, q.dtype),
+            jnp.full((B, k), -1, jnp.int32),
+            jnp.zeros((B,), jnp.int32))
+    (best, ids, cnt), _ = jax.lax.scan(step, init, probe.T)
+    return best, ids, cnt
+
+
+def _pq_body(q, centroids, postings, sizes, codes, codebooks, corpus,
+             cnorms, nprobe, k, r):
+    """IVF-PQ: ADC-score candidates from uint8 codes (M bytes each, not D
+    floats), carry a top-``r`` candidate set through the probe scan, then
+    exact-rerank the r survivors down to k from the full-precision corpus."""
+    B = q.shape[0]
+    M, ksub, dsub = codebooks.shape
+    L = postings.shape[1]
+    bucketing.telemetry().record_trace(SITE_PQ, (B, nprobe, k, r))
+    qn = jnp.sum(q * q, axis=-1)
+    centnorms = jnp.sum(centroids * centroids, axis=-1)
+    dc = qn[:, None] - 2.0 * (q @ centroids.T) + centnorms[None, :]
+    _, probe = jax.lax.top_k(-dc, nprobe)
+    # ADC table: lut[b, m, j] = ||q_m - codebook[m, j]||², one build per batch
+    lut = jnp.sum(
+        (q.reshape(B, M, 1, dsub) - codebooks[None]) ** 2, axis=-1)
+
+    def step(carry, pid):
+        best, bidx, cnt = carry
+        rows = postings[pid]                                   # [B, L]
+        valid = jnp.arange(L)[None, :] < sizes[pid][:, None]
+        cg = codes[rows].astype(jnp.int32)                     # [B, L, M]
+        adc = jnp.sum(
+            jnp.take_along_axis(lut, cg.transpose(0, 2, 1), axis=2), axis=1)
+        sc = jnp.where(valid, -adc, -jnp.inf)
+        nb, sel = jax.lax.top_k(jnp.concatenate([best, sc], axis=1), r)
+        ni = jnp.take_along_axis(
+            jnp.concatenate([bidx, rows], axis=1), sel, axis=1)
+        return (nb, ni, cnt + jnp.sum(valid, axis=1)), None
+
+    init = (jnp.full((B, r), -jnp.inf, q.dtype),
+            jnp.full((B, r), -1, jnp.int32),
+            jnp.zeros((B,), jnp.int32))
+    (approx, cand, cnt), _ = jax.lax.scan(step, init, probe.T)
+    # exact rerank of the r ADC survivors (clip keeps the gather in bounds
+    # for empty -1 slots; their -inf approx score masks them back out)
+    safe = jnp.clip(cand, 0, corpus.shape[0] - 1)
+    vecs = corpus[safe]                                        # [B, r, D]
+    dot = jnp.einsum("bd,brd->br", q, vecs)
+    d = qn[:, None] - 2.0 * dot + cnorms[safe]
+    sc = jnp.where(jnp.isfinite(approx), -d, -jnp.inf)
+    best, sel = jax.lax.top_k(sc, k)
+    return best, jnp.take_along_axis(cand, sel, axis=1), cnt
+
+
+class SearchProgram:
+    """The four compiled sites of one :class:`search.index.VectorIndex`,
+    registered on the index's AOT registry (``model=index``) so bundle
+    save/restore and ladder warmup find them exactly like model steps.
+
+    Nothing is donated: the corpus/centroid/posting arrays are the index's
+    long-lived device state, reused by every dispatch.
+    """
+
+    def __init__(self, index):
+        self.index = index
+        sp = lambda body, site, statics: StepProgram(
+            body, site, model=index, donate_argnums=(),
+            static_argnums=statics)
+        self.exact = sp(_exact_body, SITE_EXACT, (5,))
+        self.merge = sp(_merge_body, SITE_MERGE, (4,))
+        self.ivf = sp(_ivf_body, SITE_IVF, (6, 7))
+        self.pq = sp(_pq_body, SITE_PQ, (8, 9, 10))
+
+    # -- warmup ------------------------------------------------------------
+
+    def signature_grid(self) -> List[Tuple[int, int, int]]:
+        """Every (B, k, nprobe) combination a request can dispatch at: B and
+        k walk the reachable rungs of the shared ladder up to the index's
+        caps, nprobe comes from the index's (small) probe choice set. This
+        grid is what ``warm()`` compiles and what keeps the request path at
+        zero compiles — requests are padded/rounded INTO it, never out."""
+        ix = self.index
+        ladder = bucketing.ladder_from_env()
+        bs = aot.reachable_buckets(ix.config.batch_max, ladder)
+        ks = ix.k_choices
+        ps = ix.nprobe_choices
+        return [(b, k, p) for b in bs for k in ks for p in ps]
+
+    def warm(self) -> int:
+        """AOT-compile the full reachable grid for every tier this index
+        has (exact always; ivf/pq when trained; the pending-merge pair when
+        incremental adds are enabled). Idempotent; returns the number of
+        executables now warm. Bundle-restored signatures are cache hits."""
+        ix = self.index
+        d = ix.config.dim
+        dt = jnp.float32
+        zero = jnp.int32(0)
+        grid = self.signature_grid()
+        for b, k, p in grid:
+            q = jnp.zeros((b, d), dt)
+            self.exact.warm(q, ix._corpus, ix._cnorms, zero, zero, k,
+                            cost_key=f"b{b}k{k}")
+            if ix._pending_corpus is not None:
+                self.exact.warm(q, ix._pending_corpus, ix._pending_cnorms,
+                                zero, zero, k, cost_key=f"pend_b{b}k{k}")
+                sa = jnp.zeros((b, k), dt)
+                ia = jnp.zeros((b, k), jnp.int32)
+                self.merge.warm(sa, ia, sa, ia, k, cost_key=f"b{b}k{k}")
+            if ix._centroids is not None:
+                self.ivf.warm(q, ix._centroids, ix._postings, ix._sizes,
+                              ix._corpus, ix._cnorms, p, k,
+                              cost_key=f"b{b}k{k}p{p}")
+            if ix._codes is not None:
+                self.pq.warm(q, ix._centroids, ix._postings, ix._sizes,
+                             ix._codes, ix._codebooks, ix._corpus,
+                             ix._cnorms, p, k, ix.rerank_width(k),
+                             cost_key=f"b{b}k{k}p{p}")
+        n = sum(fn.compiled_count
+                for fn in (self.exact, self.merge, self.ivf, self.pq))
+        obs.event("search_warm", index=ix.config.name, grid=len(grid),
+                  executables=n)
+        return n
+
+    def compiled_count(self) -> int:
+        return sum(fn.compiled_count
+                   for fn in (self.exact, self.merge, self.ivf, self.pq))
+
+    def compiles_observed(self) -> int:
+        """Total traces recorded against the search sites (the request-path
+        compile gate reads the delta of this across a serving window)."""
+        tel = bucketing.telemetry()
+        return sum(tel.compiles(s)
+                   for s in (SITE_EXACT, SITE_MERGE, SITE_IVF, SITE_PQ))

@@ -25,6 +25,13 @@ KV-cache decode engine (nn/decode.py) behind a
 :class:`~.scheduler.GenerateWorker`, streamed over HTTP as
 ``POST /v1/models/<name>:generate`` (chunked NDJSON).
 
+So does vector search: ``registry.register_index(name, index)`` puts a
+device-resident ANN index (search/, docs/SEARCH.md) behind a
+signature-coalescing :class:`~.scheduler.SearchWorker`, served as
+``POST /v1/search`` plus the legacy ``/knn`` / ``/knnnew`` / ``/status``
+contract; search adds ``DL4J_TPU_SEARCH_BATCH_MAX``,
+``DL4J_TPU_IVF_NLIST``, ``DL4J_TPU_IVF_NPROBE`` (build-time knobs).
+
 Knobs: ``DL4J_TPU_SERVE_MAX_BATCH``, ``DL4J_TPU_SERVE_QUEUE``,
 ``DL4J_TPU_SERVE_MARGIN_MS``, ``DL4J_TPU_SERVE_WAIT_MS``,
 ``DL4J_TPU_SERVE_WAIT_QUANTUM_MS``, ``DL4J_TPU_SERVE_DEFAULT_DEADLINE_MS``,
@@ -39,7 +46,7 @@ from deeplearning4j_tpu.serve.admission import (
     TokenAdmission)
 from deeplearning4j_tpu.serve.registry import ModelRegistry
 from deeplearning4j_tpu.serve.scheduler import (
-    GenerateStream, GenerateWorker, ModelWorker, ShedError)
+    GenerateStream, GenerateWorker, ModelWorker, SearchWorker, ShedError)
 from deeplearning4j_tpu.serve.server import InferenceServer
 
 __all__ = [
@@ -51,6 +58,7 @@ __all__ = [
     "LatencyModel",
     "ModelRegistry",
     "ModelWorker",
+    "SearchWorker",
     "ServeConfig",
     "ShedError",
     "TokenAdmission",

@@ -35,7 +35,8 @@ from typing import Dict, List, Optional
 from deeplearning4j_tpu import obs
 from deeplearning4j_tpu.serve.admission import (
     GenerateConfig, LatencyModel, ServeConfig)
-from deeplearning4j_tpu.serve.scheduler import GenerateWorker, ModelWorker
+from deeplearning4j_tpu.serve.scheduler import (
+    GenerateWorker, ModelWorker, SearchWorker)
 
 __all__ = ["ModelRegistry"]
 
@@ -47,6 +48,7 @@ class ModelRegistry:
         self._lock = threading.Lock()
         self._workers: Dict[str, ModelWorker] = {}
         self._generators: Dict[str, GenerateWorker] = {}
+        self._searchers: Dict[str, SearchWorker] = {}
         self._meta: Dict[str, Dict[str, object]] = {}
 
     # -- intake ------------------------------------------------------------
@@ -135,6 +137,55 @@ class ModelRegistry:
                                  "restored", "warm_seconds")})
         return worker
 
+    def register_index(self, name: str, index, warm: bool = True,
+                       bundle: Optional[str] = None) -> SearchWorker:
+        """Put a :class:`~deeplearning4j_tpu.search.index.VectorIndex`
+        behind a signature-coalescing worker under ``name``
+        (``/v1/search``).
+
+        Same lifecycle as :meth:`register`: an ``.aotbundle`` sidecar (if
+        given) restores serialized search executables BEFORE the warm pass
+        enumerates the (B, k, nprobe) signature grid — on a cold
+        bundle-restored process every grid entry is a cache hit and the
+        request path never compiles. The tier knobs (``ivf_nlist`` /
+        ``ivf_nprobe`` / ``search_batch_max``) act at index BUILD time, so
+        a tuner trial rebuilds in its subprocess; by registration the index
+        shape is already final."""
+        from deeplearning4j_tpu.nn import aot
+
+        restored = 0
+        if bundle:
+            restored = aot.restore_bundle(index, bundle)
+        warmed = 0
+        warm_dt = 0.0
+        if warm:
+            t0 = time.perf_counter()
+            warmed = index.warm()
+            warm_dt = time.perf_counter() - t0
+            if bundle:
+                aot.save_bundle(index, bundle)
+        worker = SearchWorker(name, index, config=self.config,
+                              latency=self.latency)
+        meta = {
+            "source": "object",
+            "model_class": type(index).__name__,
+            "warmed": int(warmed),
+            "restored": int(restored),
+            "warm_seconds": round(warm_dt, 4),
+            "bundle": bundle,
+            "search": True,
+        }
+        with self._lock:
+            old = self._searchers.pop(name, None)
+            self._searchers[name] = worker
+            self._meta[f"search:{name}"] = meta
+        if old is not None:
+            old.shutdown()
+        obs.event("serve_model_loaded", model=name, mode="search", **{
+            k: meta[k] for k in ("source", "model_class", "warmed",
+                                 "restored", "warm_seconds")})
+        return worker
+
     def load(self, name: str, path: str, warm: bool = True,
              bundle: Optional[str] = None) -> ModelWorker:
         """Import the model at ``path`` (format auto-detected) and register
@@ -192,9 +243,21 @@ class ModelRegistry:
         with self._lock:
             return self._generators.get(name)
 
+    def searcher(self, name: Optional[str] = None) -> Optional[SearchWorker]:
+        """Search worker by name; with ``name=None`` (or "default") and
+        exactly one index registered, that index — the legacy /knn routes
+        carry no index name."""
+        with self._lock:
+            if name in (None, "default") and name not in self._searchers:
+                if len(self._searchers) == 1:
+                    return next(iter(self._searchers.values()))
+                return None
+            return self._searchers.get(name)
+
     def names(self) -> List[str]:
         with self._lock:
-            return sorted(set(self._workers) | set(self._generators))
+            return sorted(set(self._workers) | set(self._generators)
+                          | set(self._searchers))
 
     def describe(self) -> List[Dict[str, object]]:
         """One JSON-friendly row per served model (GET /v1/models)."""
@@ -204,6 +267,9 @@ class ModelRegistry:
             pairs += [(self._generators[n],
                        dict(self._meta.get(f"generate:{n}", {})))
                       for n in sorted(self._generators)]
+            pairs += [(self._searchers[n],
+                       dict(self._meta.get(f"search:{n}", {})))
+                      for n in sorted(self._searchers)]
         rows = []
         for worker, meta in pairs:
             row = worker.stats()
@@ -214,9 +280,11 @@ class ModelRegistry:
     def shutdown(self):
         with self._lock:
             workers = (list(self._workers.values())
-                       + list(self._generators.values()))
+                       + list(self._generators.values())
+                       + list(self._searchers.values()))
             self._workers.clear()
             self._generators.clear()
+            self._searchers.clear()
             self._meta.clear()
         for w in workers:
             w.shutdown()

@@ -21,6 +21,14 @@ is exactly what the coalescing scheduler wants: concurrent blocked
   no bytes have streamed yet; a MID-STREAM shed (deadline repriced per
   remaining token budget) arrives as the terminal line's
   ``reason == "shed:deadline"`` — the status line already said 200;
+- ``POST /v1/search`` with ``{"index": name?, "queries": [[...], ...],
+  "k": 10, "nprobe": 8?, "tier": "ivf"?, "deadline_ms": 50?}`` →
+  ``{"ids": [...], "distances": [...], "tier": ..., "rows": n}`` — the
+  device-resident ANN tier (search/, docs/SEARCH.md) behind the same
+  deadline admission + signature-coalescing scheduler, same status codes;
+- ``POST /knn`` / ``POST /knnnew`` / ``GET /status`` — the legacy
+  NearestNeighborsServer wire contract (clustering/server.py is now a thin
+  shim over this stack), resolved against the sole registered index;
 - ``GET /v1/models`` → per-model pool stats (queue depth, batches, warm
   metadata);
 - ``GET /healthz``, ``GET /metrics`` — from serve/httpcommon.py; /metrics
@@ -85,12 +93,29 @@ class InferenceServer:
                 if m:
                     return f"serve.{m.group(1)}:http"
                 m = _GENERATE_RE.match(path)
-                return f"generate.{m.group(1)}:http" if m else path
+                if m:
+                    return f"generate.{m.group(1)}:http"
+                if path in ("/v1/search", "/knn", "/knnnew"):
+                    # the index name lives in the body, not the URL; one
+                    # bounded label covers the whole search surface
+                    return "search:http"
+                return path
 
             def handle_get(self) -> int:
-                if urlparse(self.path).path == "/v1/models":
+                path = urlparse(self.path).path
+                if path == "/v1/models":
                     return self.send_json(200,
                                           {"models": outer.registry.describe()})
+                if path == "/status":
+                    worker = outer.registry.searcher(None)
+                    if worker is None:
+                        return self.send_json(
+                            404, {"error": "no index served"})
+                    ix = worker.index
+                    return self.send_json(200, {
+                        "ok": True,
+                        "points": int(ix.n + ix._pending_n),
+                        "dim": int(ix.config.dim)})
                 self.send_response(404)
                 self.end_headers()
                 return 404
@@ -155,7 +180,104 @@ class InferenceServer:
                     pass  # client went away mid-stream; engine already done
                 return 200
 
+            # -- vector search ---------------------------------------------
+
+            def _send_shed(self, e: ShedError) -> int:
+                body = {"error": str(e), "shed": e.reason}
+                if e.http_status == 429:
+                    return self.send_json(429, body,
+                                          headers=(("Retry-After", "1"),))
+                return self.send_json(503, body)
+
+            def handle_search(self) -> int:
+                try:
+                    payload = self.read_json()
+                    name = payload.get("index")
+                    queries = np.asarray(payload["queries"], np.float32)
+                    k = int(payload.get("k", 10))
+                    nprobe = payload.get("nprobe")
+                    nprobe = None if nprobe is None else int(nprobe)
+                    tier = payload.get("tier")
+                    deadline_ms = payload.get("deadline_ms")
+                    deadline_s = (None if deadline_ms is None
+                                  else float(deadline_ms) / 1e3)
+                    if deadline_s is not None and deadline_s <= 0:
+                        raise ValueError("deadline_ms must be > 0")
+                except Exception as e:
+                    return self.send_json(400, {"error": str(e)})
+                worker = outer.registry.searcher(name)
+                if worker is None:
+                    return self.send_json(
+                        404, {"error": f"index {name!r} not served",
+                              "served": outer.registry.names()})
+                try:
+                    ids, dists, tier_used = worker.submit(
+                        queries, k=k, nprobe=nprobe, tier=tier,
+                        deadline_s=deadline_s)
+                except ShedError as e:
+                    return self._send_shed(e)
+                except ValueError as e:
+                    return self.send_json(400, {"error": str(e)})
+                except Exception as e:
+                    return self.send_json(500, {"error": str(e)})
+                return self.send_json(200, {
+                    "ids": ids.tolist(),
+                    "distances": dists.tolist(),
+                    "tier": tier_used,
+                    "rows": int(len(ids)),
+                })
+
+            def handle_knn(self, by_vector: bool) -> int:
+                """Legacy NearestNeighborsServer contract: /knn looks up an
+                indexed row (excluding itself), /knnnew a raw vector; both
+                answer ``{"results": [{"index", "distance"}, ...]}`` and map
+                malformed requests to the legacy 400 ``{"error"}`` shape.
+                Sheds keep the unified 429/503 semantics (the legacy server
+                had no admission at all)."""
+                worker = outer.registry.searcher(None)
+                if worker is None:
+                    return self.send_json(404, {"error": "no index served"})
+                ix = worker.index
+                try:
+                    payload = self.read_json()
+                    k = int(payload.get("k", 5))
+                    if k < 1:
+                        raise ValueError(f"k must be >= 1, got {k}")
+                    if by_vector:
+                        vec = np.asarray(
+                            payload["ndarray"], np.float32).reshape(1, -1)
+                        exclude = -1
+                        want = min(k, ix.config.max_k)
+                    else:
+                        row = int(np.asarray(payload["ndarray"]).reshape(()))
+                        if not 0 <= row < ix.n:
+                            raise ValueError(f"index {row} out of range")
+                        vec = ix._vectors[row][None]
+                        exclude = row
+                        # one extra so dropping the query row still fills k
+                        want = min(k + 1, ix.config.max_k)
+                except ShedError:
+                    raise
+                except Exception as e:
+                    return self.send_json(400, {"error": str(e)})
+                try:
+                    ids, dists, _ = worker.submit(vec, k=want)
+                except ShedError as e:
+                    return self._send_shed(e)
+                except Exception as e:
+                    return self.send_json(400, {"error": str(e)})
+                results = [
+                    {"index": int(i), "distance": float(d)}
+                    for i, d in zip(ids[0], dists[0])
+                    if i >= 0 and i != exclude][:k]
+                return self.send_json(200, {"results": results})
+
             def handle_post(self) -> int:
+                path = urlparse(self.path).path
+                if path == "/v1/search":
+                    return self.handle_search()
+                if path in ("/knn", "/knnnew"):
+                    return self.handle_knn(by_vector=(path == "/knnnew"))
                 g = _GENERATE_RE.match(urlparse(self.path).path)
                 if g:
                     return self.handle_generate(g.group(1))

@@ -197,6 +197,27 @@ KNOBS: Tuple[Knob, ...] = (
              "with width until the padded decode step's ITL breaks the "
              "stream SLO",
     ),
+    Knob(
+        name="ivf_nlist", env="DL4J_TPU_IVF_NLIST", kind="int",
+        domain=(0, 64, 128, 256, 512), default=0, scope="serve",
+        help="IVF coarse-quantizer cell count (0 = auto ~ sqrt(n), bucket-"
+             "rounded): more cells shrink each probed posting list but cost "
+             "recall at fixed nprobe; acts at index BUILD time",
+    ),
+    Knob(
+        name="ivf_nprobe", env="DL4J_TPU_IVF_NPROBE", kind="int",
+        domain=(4, 8, 16, 32), default=8, scope="serve",
+        help="IVF cells scanned per query: the recall/latency dial — "
+             "candidates scanned grow linearly with nprobe while recall "
+             "saturates; acts at index BUILD time (fixes the warmed grid)",
+    ),
+    Knob(
+        name="search_batch_max", env="DL4J_TPU_SEARCH_BATCH_MAX", kind="int",
+        domain=(8, 16, 32, 64), default=32, scope="serve",
+        help="query-coalescing width cap for /v1/search: wider batches "
+             "amortize kernel launches until the padded top-k step blows "
+             "the per-request deadline",
+    ),
 )
 
 _BY_NAME: Dict[str, Knob] = {k.name: k for k in KNOBS}

@@ -62,6 +62,16 @@ if not (gen.get("value", 0) > 0
         and gover.get("burn_rate", 0) > 0):
     sys.exit(f"bench smoke: generate gates failed: "
              f"{ {k: v for k, v in gen.items() if k != 'obs'} }")
+# ANN search-tier acceptance gates (docs/SEARCH.md): at >=100k vectors the
+# IVF tier must beat the exact scan's p99 while holding recall@10 >= 0.9,
+# measured in a COLD bundle-restored process with ZERO request-path compiles
+vs = next(m for m in extras if m["metric"] == "vector_search_p99")
+if not (vs.get("corpus", 0) >= 100_000
+        and vs.get("recall_at_10", 0) >= 0.9
+        and vs.get("request_path_compiles", -1) == 0
+        and 0 < vs.get("ivf_p99_ms", 0) < vs.get("exact_p99_ms", 0)):
+    sys.exit(f"bench smoke: vector_search gates failed: "
+             f"{ {k: v for k, v in vs.items() if k != 'obs'} }")
 print(f"bench smoke OK: {len(extras)} metrics, no errors, obs embedded")
 EOF
 
