@@ -75,12 +75,16 @@ __all__ = [
     "AotFunction",
     "BUNDLE_FORMAT_VERSION",
     "bundle_path_for",
+    "distributed_bundle_manifest",
+    "distributed_bundle_path",
     "enabled",
     "model_signature",
     "persistence_allowed",
     "reachable_buckets",
     "restore_bundle",
+    "restore_distributed_bundle",
     "save_bundle",
+    "save_distributed_bundle",
     "toolchain_fingerprint",
     "validate_persistence",
     "warm_dp",
@@ -851,6 +855,109 @@ def _attach_standard_fns(model) -> None:
         model._get_step_fn(True)
     if f"{prefix}.output" in pending:
         model._get_output_fn()
+
+
+# ---------------------------------------------------------------------------
+# Distributed bundles (elastic multi-host checkpoint layout)
+# ---------------------------------------------------------------------------
+
+
+def distributed_bundle_path(base, rank: int) -> str:
+    """Per-host executable-bundle shard path under the elastic checkpoint
+    layout: ``<base>_r<rank>.aotbundle``."""
+    return f"{os.fspath(base)}_r{int(rank)}.aotbundle"
+
+
+def _distributed_sidecar(base, rank: int) -> str:
+    return f"{os.fspath(base)}_r{int(rank)}.aotmanifest.json"
+
+
+def save_distributed_bundle(model, base, rank: int) -> Optional[dict]:
+    """Write this host's executable-bundle shard plus a CRC'd sidecar
+    manifest entry. Bundles hold compiled executables for the REPLICATED
+    model program — identical across data-parallel ranks — so any rank's
+    shard can warm any other rank (the straggler-serving property the
+    distributed restore exploits). Gated and non-raising like
+    :func:`save_bundle`; returns its info dict or None."""
+    path = distributed_bundle_path(base, rank)
+    info = save_bundle(model, path)
+    if info is None:
+        return None
+    try:
+        entry = {
+            "rank": int(rank),
+            "file": os.path.basename(path),
+            "crc32": _file_crc32(path),
+            "size": os.path.getsize(path),
+            "model_signature": model_signature(model),
+            **toolchain_fingerprint(),
+        }
+        tmp = f"{_distributed_sidecar(base, rank)}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(entry, f, indent=1)
+        os.replace(tmp, _distributed_sidecar(base, rank))
+        info["manifest"] = entry
+    except Exception as e:
+        obs.event("aot_bundle_save_failed", path=str(path), error=repr(e))
+    return info
+
+
+def _file_crc32(path) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(1 << 20)
+            if not buf:
+                break
+            crc = zlib.crc32(buf, crc)
+    return crc & 0xFFFFFFFF
+
+
+def distributed_bundle_manifest(base) -> Dict[int, dict]:
+    """Merge the per-rank sidecar manifests for ``base`` into
+    ``{rank: entry}``; unreadable sidecars are dropped (their bundles will
+    fail CRC anyway)."""
+    import glob as _glob
+
+    out: Dict[int, dict] = {}
+    for p in sorted(_glob.glob(f"{os.fspath(base)}_r*.aotmanifest.json")):
+        try:
+            with open(p, "r") as f:
+                entry = json.load(f)
+            out[int(entry["rank"])] = entry
+        except (OSError, ValueError, KeyError):
+            continue
+    return out
+
+
+def restore_distributed_bundle(model, base, rank: int) -> int:
+    """Restore executables from the distributed bundle layout: this rank's
+    own shard first, then — because the executables are rank-agnostic — ANY
+    other rank's CRC-valid shard (a rejoining straggler whose own shard is
+    lost or corrupt warms itself from a survivor's). Returns executables
+    installed; 0 on nothing usable (the recompile path, never raises)."""
+    manifest = distributed_bundle_manifest(base)
+    order = [rank] + sorted(t for t in manifest if t != rank)
+    for t in order:
+        path = distributed_bundle_path(base, t)
+        if not os.path.exists(path):
+            continue
+        entry = manifest.get(t)
+        if entry is not None:
+            try:
+                if (_file_crc32(path) != entry.get("crc32")
+                        or os.path.getsize(path) != entry.get("size")):
+                    _reject(path, "crc_mismatch", rank=t)
+                    continue
+            except OSError:
+                continue
+        n = restore_bundle(model, path)
+        if n > 0:
+            if t != rank:
+                obs.event("aot_bundle_served_by_peer", rank=rank,
+                          served_by=t, path=str(path))
+            return n
+    return 0
 
 
 # ---------------------------------------------------------------------------

@@ -38,6 +38,13 @@ from deeplearning4j_tpu.parallel.compress import (
     unpack_ternary,
 )
 from deeplearning4j_tpu.parallel.grads import DataParallelStep, GradExchange
+from deeplearning4j_tpu.parallel.elastic import (
+    ElasticRuntime,
+    FileStore,
+    Membership,
+    MembershipChanged,
+    View,
+)
 from deeplearning4j_tpu.parallel.gpipe import GPipeTrainer
 from deeplearning4j_tpu.parallel.ring import local_attention, ring_self_attention
 from deeplearning4j_tpu.parallel.pipeline import PipelineParallel, stack_stage_params
@@ -51,6 +58,7 @@ __all__ = [
     "tp_param_shardings", "init_distributed", "shutdown_distributed",
     "is_multihost", "global_array", "replicate_global",
     "DataParallelStep", "GradExchange", "data_axis_size", "data_sharded",
+    "ElasticRuntime", "FileStore", "Membership", "MembershipChanged", "View",
     "MeshTrainer", "shard_update_spec",
     "threshold_encode", "threshold_decode", "pack_ternary", "unpack_ternary",
     "encode_packed", "decode_gathered", "packed_nbytes",

@@ -274,8 +274,10 @@ class DataParallelStep:
         if jax.process_count() > 1:
             raise NotImplementedError(
                 "DataParallelStep supports single-process meshes only; "
-                "multi-host explicit exchange needs per-process opt-state "
-                "assembly (use the implicit dense path meanwhile)")
+                "for multi-process data parallelism use the elastic runtime "
+                "(train/elastic.py ElasticTrainer over parallel/elastic.py "
+                "membership), which shards the optimizer update and "
+                "compresses payloads across hosts")
         if model.params is None:
             model.init()
         from deeplearning4j_tpu.nn.graph import ComputationGraph

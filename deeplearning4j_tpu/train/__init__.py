@@ -55,7 +55,6 @@ from deeplearning4j_tpu.train.earlystopping import (
     MaxTimeIterationTerminationCondition,
     ScoreImprovementEpochTerminationCondition,
 )
-
 __all__ = [
     "Updater",
     "make_updater",
@@ -64,6 +63,7 @@ __all__ = [
     "schedule_value",
     "ChaosInjector",
     "ChaosPreemption",
+    "ElasticTrainer",
     "DivergenceError",
     "DivergenceGuard",
     "active_chaos",
@@ -97,3 +97,13 @@ __all__ = [
     "InMemoryModelSaver",
     "LocalFileModelSaver",
 ]
+
+
+def __getattr__(name):
+    # lazy: train.elastic pulls in the whole parallel package, whose wrapper
+    # module reaches back into nn.model — eager import here would cycle
+    if name == "ElasticTrainer":
+        from deeplearning4j_tpu.train.elastic import ElasticTrainer
+
+        return ElasticTrainer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -38,6 +38,9 @@ See docs/ROBUSTNESS.md for the checkpoint format and recovery semantics.
 
 from __future__ import annotations
 
+import glob
+import io
+import json
 import math
 import os
 import signal
@@ -46,7 +49,7 @@ import warnings
 import zipfile
 import zlib
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -65,12 +68,76 @@ __all__ = [
     "capture_train_state",
     "crc32_file",
     "install_chaos",
+    "io_with_retries",
+    "load_distributed_checkpoint",
     "load_state_into",
     "note_score",
     "resume",
     "save_checkpoint",
     "validate_checkpoint",
+    "write_bytes_durable",
+    "write_json_durable",
 ]
+
+
+# ---------------------------------------------------------------------------
+# Retrying I/O: bounded exponential backoff for checkpoint reads/writes
+# ---------------------------------------------------------------------------
+
+
+def _retry_knobs():
+    return (int(os.environ.get("DL4J_TPU_CKPT_RETRIES", "3")),
+            float(os.environ.get("DL4J_TPU_CKPT_RETRY_BASE_S", "0.05")),
+            float(os.environ.get("DL4J_TPU_CKPT_RETRY_CAP_S", "2.0")))
+
+
+def io_with_retries(fn: Callable[[], Any], *, what: str = "ckpt_io"):
+    """Run a checkpoint I/O callable, retrying ``OSError`` with bounded
+    exponential backoff (``DL4J_TPU_CKPT_RETRIES`` attempts beyond the
+    first, delay ``base * 2**k`` capped at ``DL4J_TPU_CKPT_RETRY_CAP_S``).
+    Network filesystems fail transiently under exactly the membership churn
+    the elastic runtime is built for; each retry increments
+    ``dl4j_ckpt_retries_total``. Exhaustion re-raises the last error."""
+    retries, base, cap = _retry_knobs()
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except OSError as e:
+            if attempt >= retries:
+                raise
+            delay = min(base * (2 ** attempt), cap)
+            obs.counter("dl4j_ckpt_retries_total",
+                        "Checkpoint I/O operations retried after a "
+                        "transient OSError").inc()
+            obs.event("ckpt_io_retry", what=what, attempt=attempt + 1,
+                      error=str(e), delay_s=round(delay, 4))
+            time.sleep(delay)
+
+
+def write_bytes_durable(path, data: bytes) -> None:
+    """Atomic durable byte write (tmp + fsync + ``os.replace``) with retry
+    backoff — the primitive under the distributed checkpoint shards."""
+
+    def attempt():
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+
+    io_with_retries(attempt, what=f"write:{os.path.basename(str(path))}")
+
+
+def write_json_durable(path, value) -> None:
+    write_bytes_durable(path, json.dumps(value, indent=1).encode("utf-8"))
 
 
 # ---------------------------------------------------------------------------
@@ -154,9 +221,11 @@ def save_checkpoint(model, path, normalizer: Optional[dict] = None) -> dict:
             if getattr(runner, "_active", False):
                 opt_state = runner.snapshot_opt_state()
             residuals = runner.export_residuals() or None
-        S.save_network(model, path, normalizer=normalizer,
-                       train_state=capture_train_state(model),
-                       residuals=residuals, opt_state=opt_state)
+        io_with_retries(
+            lambda: S.save_network(model, path, normalizer=normalizer,
+                                   train_state=capture_train_state(model),
+                                   residuals=residuals, opt_state=opt_state),
+            what=f"save_network:{os.path.basename(str(path))}")
         info = {"path": path, "crc": crc32_file(path),
                 "size": os.path.getsize(path)}
     dur = time.perf_counter() - t0
@@ -223,6 +292,70 @@ def resume(model, directory):
 
     aot.restore_bundle(model, aot.bundle_path_for(path))
     return cp
+
+
+# ---------------------------------------------------------------------------
+# Distributed checkpoints (elastic multi-host layout)
+# ---------------------------------------------------------------------------
+
+
+def load_distributed_checkpoint(directory) -> Optional[dict]:
+    """Load the newest VALID distributed checkpoint from ``directory``.
+
+    The elastic trainer's layout (docs/ROBUSTNESS.md): per-host shard files
+    ``shard_<tag>_r<rank>.npz`` (each rank's optimizer segments — its
+    primary 1/W slice AND its buddy's mirror — plus compression residuals),
+    a replicated ``ckpt_<tag>_params.npz`` (params, dense opt state, layer
+    state, meta), and a ``manifest_<tag>.json`` with per-file CRC32 + size
+    written LAST by rank 0 — the commit point.
+
+    Validation is per-file: a manifest whose params file fails its CRC falls
+    back to the next-older manifest; a corrupt *shard* file is dropped
+    individually, because every segment it held also lives in its buddy's
+    shard (any host can serve a straggler's shard) — only the trainer can
+    judge whether the surviving set covers every segment. Returns
+    ``{"manifest", "params", "shards": {rank: arrays}, "path"}`` or None.
+    """
+    directory = os.fspath(directory)
+    manifests = sorted(glob.glob(os.path.join(directory, "manifest_*.json")),
+                       reverse=True)
+    for mpath in manifests:
+        try:
+            with open(mpath, "r") as f:
+                man = json.load(f)
+        except (OSError, ValueError):
+            obs.event("checkpoint_corrupt_fallback", path=mpath,
+                      reason="manifest unreadable")
+            continue
+        ppath = os.path.join(directory, man["params"]["file"])
+        if not validate_checkpoint(ppath, crc=man["params"]["crc"],
+                                   size=man["params"]["size"]):
+            obs.event("checkpoint_corrupt_fallback", path=ppath,
+                      reason="params file failed CRC/size")
+            continue
+        pdata = io_with_retries(
+            lambda: open(ppath, "rb").read(), what="read:params")
+        with np.load(io.BytesIO(pdata), allow_pickle=False) as z:
+            params = {k: z[k] for k in z.files}
+        shards: Dict[int, Dict[str, np.ndarray]] = {}
+        for rank_s, meta in man.get("shards", {}).items():
+            spath = os.path.join(directory, meta["file"])
+            if not validate_checkpoint(spath, crc=meta["crc"],
+                                       size=meta["size"]):
+                obs.event("checkpoint_shard_dropped", path=spath,
+                          rank=int(rank_s), reason="failed CRC/size")
+                continue
+            sdata = io_with_retries(
+                lambda p=spath: open(p, "rb").read(),
+                what=f"read:shard{rank_s}")
+            with np.load(io.BytesIO(sdata), allow_pickle=False) as z:
+                shards[int(rank_s)] = {k: z[k] for k in z.files}
+        obs.event("distributed_checkpoint_loaded", path=mpath,
+                  world=man.get("world"), shards=sorted(shards),
+                  iteration=man.get("iteration"))
+        return {"manifest": man, "params": params, "shards": shards,
+                "path": mpath}
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -404,7 +537,8 @@ class _Fault:
     fired: bool = False
 
 
-_FAULT_KINDS = ("preempt", "corrupt_ckpt", "nan_grad", "slow_iter")
+_FAULT_KINDS = ("preempt", "corrupt_ckpt", "nan_grad", "slow_iter",
+                "host_kill", "net_partition")
 
 
 def _parse_fault(token: str) -> _Fault:
@@ -416,7 +550,8 @@ def _parse_fault(token: str) -> _Fault:
             raise ValueError(
                 f"chaos fault {token!r}: anchor must be @iter:K or @ckpt:K")
         where, val = parts[0], parts[1]
-        arg = parts[2] if len(parts) > 2 else None
+        # args may themselves contain ':' (e.g. net_partition's rank1:4.0)
+        arg = ":".join(parts[2:]) or None
         if where == "iter":
             at_iter = int(val)
         elif where == "ckpt":
@@ -484,6 +619,16 @@ class ChaosInjector:
     - ``corrupt_ckpt[@ckpt:K][:truncate|bitflip]`` — damage checkpoint
       number K (or the first one written) AFTER its CRC is recorded, so
       validation must catch it. Fires once.
+    - ``host_kill@iter:K[:rankN]`` — the distributed flavor of kill: SIGKILL
+      the process before the step whose iteration is >= K, only when this
+      worker's data-parallel rank matches the ``rankN`` target (no target:
+      every rank that consults the hook). Fires once; drives the elastic
+      shrink path (tests/test_elastic.py, tools/elastic_smoke.sh).
+    - ``net_partition@iter:K[:rankN][:seconds]`` — simulate this worker
+      landing on the wrong side of a switch: the elastic runtime suspends
+      its lease heartbeat and stalls for ``seconds`` (default 5.0). A stall
+      longer than the lease TTL gets the worker expelled; on waking it
+      renews its lease and rejoins through the membership handoff.
 
     Faults are host-side and one-shot: a resumed run that re-executes the
     target iteration is NOT re-hit (the process that resumed carries a fresh
@@ -532,6 +677,47 @@ class ChaosInjector:
                 obs.event("chaos", fault="nan_grad", iteration=iteration)
                 return _nan_like(x)
         return x
+
+    # -- distributed hooks (ElasticTrainer step boundary) -------------------
+    @staticmethod
+    def _rank_arg(arg: Optional[str]):
+        """Split a fault arg into (target_rank, rest): ``rank1:4.0`` ->
+        (1, "4.0"), ``rank2`` -> (2, None), ``3.5`` -> (None, "3.5")."""
+        if not arg:
+            return None, None
+        head, _, rest = arg.partition(":")
+        if head.startswith("rank") and head[4:].isdigit():
+            return int(head[4:]), (rest or None)
+        return None, arg
+
+    def maybe_host_kill(self, iteration: int, *, rank: int) -> None:
+        for f in self.faults:
+            if (f.kind != "host_kill" or f.fired or f.at_iter is None
+                    or iteration < f.at_iter):
+                continue
+            target, _ = self._rank_arg(f.arg)
+            if target is not None and target != rank:
+                continue
+            f.fired = True
+            obs.event("chaos", fault="host_kill", iteration=iteration,
+                      rank=rank)
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def partition_seconds(self, iteration: int, *, rank: int) -> float:
+        """Non-zero when a ``net_partition`` fault targets this (iteration,
+        rank); the caller owns the mechanics (suspend heartbeat + stall)."""
+        for f in self.faults:
+            if (f.kind != "net_partition" or f.fired or f.at_iter is None
+                    or iteration < f.at_iter):
+                continue
+            target, rest = self._rank_arg(f.arg)
+            if target is not None and target != rank:
+                continue
+            f.fired = True
+            obs.event("chaos", fault="net_partition", iteration=iteration,
+                      rank=rank, seconds=rest)
+            return float(rest) if rest else 5.0
+        return 0.0
 
     # -- checkpoint hook (CheckpointListener._save) -------------------------
     def maybe_corrupt(self, path, ckpt_number: int) -> None:
