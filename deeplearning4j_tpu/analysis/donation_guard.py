@@ -1,0 +1,114 @@
+"""Runtime donation guard: donated host references must actually die.
+
+The static side (``rules.py`` ``use-after-donate``) proves code doesn't
+*obviously* read a buffer after donating it into a step executable; this
+module proves the *process* didn't get away with one the analysis missed.
+The gap exists because backends are forgiving: XLA:CPU may silently ignore
+``donate_argnums`` (the input stays live and a use-after-donate reads the
+stale-but-valid old buffer — the silent-wrong-answer flavor), while TPU/GPU
+alias the buffer away (the same read returns garbage or raises). A test
+suite that only runs on CPU therefore can't catch the bug class the
+donation contract exists for.
+
+Under ``DL4J_TPU_DONATION_GUARD=1``, :class:`StepProgram.__call__`
+(``nn/step_program.py``) calls :func:`check_after_dispatch` after every
+donating dispatch. The guard blocks on the outputs, then POISONS every
+donated input leaf the backend left alive — ``jax.Array.delete()`` — so
+any later host read raises ``RuntimeError: Array has been deleted`` loudly,
+exactly where a real accelerator would have returned garbage. Each poisoned
+leaf increments ``dl4j_donation_guard_trips_total`` and logs one obs event
+per site.
+
+Opt-in for the same reason the retrace guard is: poisoning is the point,
+and it converts donation-contract leniency into hard failures — a debug
+mode for tests and repros, never a default. Nothing here imports jax at
+module import time.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, List, Sequence, Set, Tuple
+
+from deeplearning4j_tpu import obs
+
+__all__ = [
+    "GuardTrip",
+    "TRIPS_COUNTER",
+    "check_after_dispatch",
+    "enabled",
+    "reset_warnings",
+]
+
+TRIPS_COUNTER = "dl4j_donation_guard_trips_total"
+
+_trips = obs.counter(
+    TRIPS_COUNTER,
+    "donated-but-live input buffers poisoned by the donation guard")
+
+
+def enabled() -> bool:
+    return os.environ.get("DL4J_TPU_DONATION_GUARD", "0") != "0"
+
+
+@dataclass(frozen=True)
+class GuardTrip:
+    """One donated input leaf the backend left alive (now poisoned)."""
+
+    site: str
+    position: int       # donate_argnums position of the offending argument
+    shape: Tuple[int, ...]
+
+
+# one obs event per site per process; tests reset between cases
+_evented: Set[str] = set()
+_evented_lock = threading.Lock()
+
+
+def reset_warnings() -> None:
+    with _evented_lock:
+        _evented.clear()
+
+
+def check_after_dispatch(site: str, args: Sequence[Any],
+                         donate_argnums: Sequence[int],
+                         outputs: Any) -> List[GuardTrip]:
+    """Poison donated inputs that survived ``site``'s dispatch.
+
+    Blocks on ``outputs`` first (an async in-flight execution may still be
+    reading its inputs), then deletes every donated input leaf that is a
+    live ``jax.Array``. On backends that honor donation the leaves are
+    already deleted and this is a no-op sweep; on forgiving backends each
+    deletion is a trip — counted, evented once per site, and guaranteed to
+    turn any missed use-after-donate into an immediate RuntimeError."""
+    if not enabled() or not donate_argnums:
+        return []
+    import jax
+
+    jax.block_until_ready(outputs)
+    trips: List[GuardTrip] = []
+    for pos in donate_argnums:
+        if pos >= len(args):
+            continue
+        for leaf in jax.tree_util.tree_leaves(args[pos]):
+            if not isinstance(leaf, jax.Array):
+                continue
+            try:
+                if leaf.is_deleted():
+                    continue
+                shape = tuple(leaf.shape)
+                leaf.delete()
+            except (RuntimeError, AttributeError):  # already invalidated
+                continue
+            trips.append(GuardTrip(site, pos, shape))
+            _trips.inc()
+    if trips:
+        with _evented_lock:
+            first = site not in _evented
+            _evented.add(site)
+        if first:
+            obs.event("donation_guard", site=site, poisoned=len(trips),
+                      positions=sorted({t.position for t in trips}))
+    return trips

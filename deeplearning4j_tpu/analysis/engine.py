@@ -15,6 +15,13 @@ rules care about:
   A host sync here (``np.asarray``/``.item()``/``float()`` on a device
   value) stalls the pipeline the shape-bucketing work keeps hot.
 
+On top of the function classification sits an interprocedural,
+field-sensitive value layer (:mod:`deeplearning4j_tpu.analysis.dataflow`,
+reached lazily through :attr:`Index.dataflow`): def-use chains threaded
+across this call graph with ``self.<attr>`` tracked per class. The
+distributed-correctness rules (use-after-donate, collective-consistency,
+durable-store-protocol — :mod:`analysis.rules_distributed`) run on it.
+
 Resolution is deliberately approximate (bare names in module scope,
 ``self.``/``cls.`` within same-module classes, ``module.attr`` through
 package imports); the baseline + inline suppressions absorb the
@@ -252,6 +259,17 @@ class Index:
         self._build_call_graph()
         self._find_jit()
         self._compute_sets()
+        self._dataflow = None
+
+    @property
+    def dataflow(self):
+        """The interprocedural field-sensitive value layer
+        (:class:`analysis.dataflow.Dataflow`), built on first use — the
+        classification rules never pay for it."""
+        if self._dataflow is None:
+            from deeplearning4j_tpu.analysis.dataflow import Dataflow
+            self._dataflow = Dataflow(self)
+        return self._dataflow
 
     # -- per-module scan ---------------------------------------------------
     def _scan_module(self, sm: SourceModule):

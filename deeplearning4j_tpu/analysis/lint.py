@@ -6,6 +6,8 @@ Usage::
     python -m deeplearning4j_tpu.analysis.lint PKG --fix-baseline
     python -m deeplearning4j_tpu.analysis.lint PKG --no-baseline --json
     python -m deeplearning4j_tpu.analysis.lint PKG --rules host-sync,jit-purity
+    python -m deeplearning4j_tpu.analysis.lint PKG --changed
+    python -m deeplearning4j_tpu.analysis.lint PKG --sarif out.sarif
 
 Baseline workflow: ``baseline.json`` (next to this module by default) maps
 line-number-free fingerprints (``path::rule::func::normalized-line-text``)
@@ -14,7 +16,16 @@ to allowed occurrence counts. Findings beyond the baseline fail the run
 stale (informational). ``--fix-baseline`` rewrites the file to match the
 current findings exactly — review the diff like any other code change.
 
-Exit codes: 0 clean (vs baseline), 1 new findings, 2 usage/parse error.
+``--changed`` scopes the verdict to files git reports as modified or
+untracked (the fast pre-commit path: the whole index is still built — the
+interprocedural rules need it — but only findings in changed files can fail
+the run, and stale-fingerprint noise from unchanged files is suppressed).
+``--sarif FILE`` additionally writes a SARIF 2.1.0 log (``-`` = stdout):
+new findings as ``error``/``baselineState: new``, grandfathered ones as
+``note``/``unchanged``.
+
+Exit codes (the tools/lint.sh contract, asserted by tools/bench_smoke.sh):
+0 clean (vs baseline), 1 new findings, 2 usage/parse/git error.
 """
 
 from __future__ import annotations
@@ -22,9 +33,10 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 from collections import Counter
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Set
 
 from deeplearning4j_tpu.analysis import rules as rules_mod
 from deeplearning4j_tpu.analysis.engine import Finding, Index
@@ -51,6 +63,27 @@ def save_baseline(path: str, findings: Sequence[Finding]) -> None:
     with open(path, "w", encoding="utf-8") as f:
         json.dump(data, f, indent=2, sort_keys=False)
         f.write("\n")
+
+
+def changed_paths(root: str) -> Optional[Set[str]]:
+    """Paths (relative to the lint root's parent, i.e. the same convention
+    as ``Finding.path``) git reports as modified vs HEAD or untracked.
+    None when git is unavailable / not a repository."""
+    parent = os.path.dirname(os.path.abspath(root))
+    out: Set[str] = set()
+    # --relative / ls-files both yield paths relative to the -C directory,
+    # matching the Finding.path convention
+    for args in (["diff", "--name-only", "--relative", "HEAD", "--"],
+                 ["ls-files", "--others", "--exclude-standard"]):
+        try:
+            proc = subprocess.run(
+                ["git", "-C", parent] + args,
+                capture_output=True, text=True, timeout=30, check=True)
+        except (OSError, subprocess.SubprocessError):
+            return None
+        out.update(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip())
+    return out
 
 
 def diff_baseline(findings: Sequence[Finding], allowed: Dict[str, int]):
@@ -85,6 +118,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          f"(default: all of {','.join(rules_mod.ALL_RULES)})")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit findings as a json array instead of text")
+    ap.add_argument("--changed", action="store_true",
+                    help="only findings in files git reports as changed "
+                         "(vs HEAD) or untracked can fail the run — the "
+                         "fast pre-commit path")
+    ap.add_argument("--sarif", default=None, metavar="FILE",
+                    help="also write a SARIF 2.1.0 log to FILE ('-' for "
+                         "stdout)")
     args = ap.parse_args(argv)
 
     if not os.path.exists(args.target):
@@ -108,7 +148,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     findings = rules_mod.run(index, selected)
 
+    scope: Optional[Set[str]] = None
+    if args.changed:
+        scope = changed_paths(args.target)
+        if scope is None:
+            print("graftlint: --changed requires git and a repository "
+                  "above the target", file=sys.stderr)
+            return 2
+        findings = [f for f in findings if f.path in scope]
+
     if args.fix_baseline:
+        if args.changed:
+            print("graftlint: --fix-baseline cannot be combined with "
+                  "--changed (it would drop every unchanged file's "
+                  "baseline entry)", file=sys.stderr)
+            return 2
         path = args.baseline or DEFAULT_BASELINE
         save_baseline(path, findings)
         print(f"graftlint: wrote {len(findings)} finding(s) "
@@ -129,6 +183,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             return 2
 
     new, old, stale = diff_baseline(findings, allowed)
+    if args.changed:
+        # scoped runs see only a slice of the findings, so absent
+        # fingerprints are expected, not actionable
+        stale = []
+
+    if args.sarif:
+        from deeplearning4j_tpu.analysis.sarif import to_sarif
+        doc = json.dumps(to_sarif(findings, new), indent=2)
+        if args.sarif == "-":
+            print(doc)
+        else:
+            with open(args.sarif, "w", encoding="utf-8") as fh:
+                fh.write(doc + "\n")
 
     if args.as_json:
         print(json.dumps([

@@ -1,4 +1,4 @@
-"""graftlint rule engine: the five trace-safety rule classes.
+"""graftlint rule engine: trace-safety + distributed-correctness rules.
 
 | rule              | set it runs on        | hazard                               |
 |-------------------|-----------------------|--------------------------------------|
@@ -11,6 +11,14 @@
 | cost-analysis-off-hot-path | traced + hot | HLO cost walk / trace export per batch |
 | tuner-off-hot-path | traced + hot         | tuner search/trial (compiles, subprocesses, timers) per batch |
 | step-wiring       | nn/ + parallel/       | donated-carry jit built outside nn/step_program.py |
+| use-after-donate  | dataflow (donations)  | read of a buffer donated into a step |
+| collective-consistency | shard_map bodies | rank-divergent / axis-mismatched collectives |
+| durable-store-protocol | dataflow (paths) | raw (non-atomic) writes on durable store paths |
+
+The last three run on the interprocedural field-sensitive dataflow layer
+(``Index.dataflow``) and live in :mod:`analysis.rules_distributed`; this
+module re-exports them through :data:`ALL_RULES` / :func:`run` so the CLI
+and baseline treat every rule uniformly.
 
 Each checker yields ``engine.Finding`` objects; inline
 ``# graftlint: disable=<rule>`` suppressions are honored by
@@ -31,6 +39,10 @@ from deeplearning4j_tpu.analysis.engine import (
     is_jit_call,
     own_nodes,
 )
+from deeplearning4j_tpu.analysis.rules_distributed import (
+    DISTRIBUTED_RULES,
+    run_distributed,
+)
 
 __all__ = ["ALL_RULES", "run"]
 
@@ -44,7 +56,7 @@ ALL_RULES = (
     "cost-analysis-off-hot-path",
     "tuner-off-hot-path",
     "step-wiring",
-)
+) + DISTRIBUTED_RULES
 
 # numpy calls that only touch metadata — safe on tracers and device arrays
 NP_METADATA_OK = {
@@ -83,6 +95,8 @@ def run(index: Index, rules: Optional[Sequence[str]] = None) -> List[Finding]:
         out += _rule_tuner_off_hot_path(index)
     if "step-wiring" in active:
         out += _rule_step_wiring(index)
+    if active & set(DISTRIBUTED_RULES):
+        out += run_distributed(index, sorted(active & set(DISTRIBUTED_RULES)))
     # drop duplicates (one line can trip a rule through several sub-checks)
     seen: Set[tuple] = set()
     uniq = []

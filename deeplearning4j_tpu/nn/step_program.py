@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu import obs
-from deeplearning4j_tpu.analysis import retrace_guard
+from deeplearning4j_tpu.analysis import donation_guard, retrace_guard
 
 __all__ = [
     "CHAIN_AUTO_PARAM_LIMIT",
@@ -112,6 +112,11 @@ class StepProgram:
 
             if _profile.wants_exemplar(self.site):
                 _profile.note_exemplar(self.site, self._fn, args, kwargs)
+        if self.donate_argnums and donation_guard.enabled():
+            # debug mode: poison donated inputs the backend left alive so a
+            # use-after-donate the static rule missed fails loudly on CPU too
+            donation_guard.check_after_dispatch(
+                self.site, args, self.donate_argnums, out)
         return out
 
     def dispatch(self, *args, **kwargs):

@@ -491,7 +491,9 @@ class GPipeTrainer:
 
                 def stage_apply(params, x, micro):
                     idx = lax.axis_index(axis_name)
-                    return lax.switch(idx, wrapped, params, x, micro,
+                    # every arm is collective-free (stage layers; outputs
+                    # pvary-normalized) and check_vma stays on below
+                    return lax.switch(idx, wrapped, params, x, micro,  # graftlint: disable=collective-consistency
                                       rng_v, *extra)
 
                 return _gpipe_shard(

@@ -1202,9 +1202,20 @@ def _cmd_worker(args) -> int:
     for key, p in enumerate(model.params):
         for li, leaf in enumerate(jax.tree_util.tree_leaves(p)):
             params[f"p{key}_{li}"] = np.asarray(leaf)  # graftlint: disable=host-sync
-    np.savez(os.path.join(args.outdir, f"params_{args.id}.npz"), **params)
-    with open(os.path.join(args.outdir, f"result_{args.id}.json"), "w") as f:
+    # Publish atomically: the harness (and a relaunch supervisor) may read
+    # these while a preemption kills this process mid-write — a torn
+    # params_N.npz/result_N.json would poison the post-mortem checks.
+    params_path = os.path.join(args.outdir, f"params_{args.id}.npz")
+    tmp = params_path + f".{os.getpid()}.tmp.npz"  # np.savez appends .npz
+    np.savez(tmp, **params)
+    os.replace(tmp, params_path)
+    result_path = os.path.join(args.outdir, f"result_{args.id}.json")
+    tmp = result_path + f".tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
         json.dump(result, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, result_path)
     print(json.dumps(result))
     return 0
 

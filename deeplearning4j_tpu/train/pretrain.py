@@ -54,7 +54,11 @@ def pretrain_layer(model: MultiLayerNetwork, layer_idx: int, data,
         return new_params, new_opt, loss
 
     jstep = jax.jit(step, donate_argnums=(0, 1))
-    lparams = model.params[layer_idx]
+    # Copy the layer's params before they enter the donated step chain:
+    # lparams aliases model.params[layer_idx], and the first dispatch would
+    # otherwise invalidate the buffer still reachable through model.params
+    # (read every iteration by the _forward featurizer below).
+    lparams = jax.tree_util.tree_map(jnp.copy, model.params[layer_idx])
     it = 0
     for _ in range(epochs):
         source = data() if callable(data) else data

@@ -1,0 +1,111 @@
+"""graftlint fixture: collective-consistency true positives / good shapes.
+
+Every member of a mesh axis must issue the SAME collective sequence with
+the SAME axis names — the fixture covers the three sub-checks: collectives
+under rank-dependent control flow, axis names the enclosing shard_map does
+not bind (or binds twice), and cond/switch arms whose collective sequences
+diverge.
+"""
+
+import jax
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def _step_ok(x):
+    # OK: unconditional collective on the bound axis
+    return lax.psum(x, "data")
+
+
+def _step_wrong_axis(x):
+    # BAD: the enclosing shard_map binds only "data"
+    return lax.psum(x, "model")
+
+
+def _step_dup_axis(x):
+    # BAD: same axis reduced twice in one spec
+    return lax.psum(x, ("data", "data"))
+
+
+def _step_suppressed(x):
+    return lax.psum(x, "model")  # graftlint: disable=collective-consistency
+
+
+def run_ok(mesh, x):
+    return shard_map(_step_ok, mesh=mesh, in_specs=P("data"),
+                     out_specs=P("data"))(x)
+
+
+def run_wrong_axis(mesh, x):
+    return shard_map(_step_wrong_axis, mesh=mesh, in_specs=P("data"),
+                     out_specs=P("data"))(x)
+
+
+def run_dup_axis(mesh, x):
+    return shard_map(_step_dup_axis, mesh=mesh, in_specs=P("data"),
+                     out_specs=P("data"))(x)
+
+
+def run_suppressed(mesh, x):
+    return shard_map(_step_suppressed, mesh=mesh, in_specs=P("data"),
+                     out_specs=P("data"))(x)
+
+
+def ranky_bad(x):
+    # BAD: members where idx != 0 skip the psum and deadlock the axis
+    idx = lax.axis_index("data")
+    if idx == 0:
+        x = lax.psum(x, "data")
+    return x
+
+
+def ranky_hoisted_ok(x):
+    # OK: the collective runs on every member; only the local summand is
+    # rank-dependent
+    idx = lax.axis_index("data")
+    contrib = jax.numpy.where(idx == 0, x, 0.0)
+    return lax.psum(contrib, "data")
+
+
+def ranky_suppressed(x):
+    idx = lax.axis_index("data")
+    if idx == 0:
+        x = lax.psum(x, "data")  # graftlint: disable=collective-consistency
+    return x
+
+
+def _arm_psum(x):
+    return lax.psum(x, "data")
+
+
+def _arm_plain(x):
+    return x * 2.0
+
+
+def _arm_psum_too(x):
+    return lax.psum(x, "data") * 2.0
+
+
+def cond_divergent_bad(x):
+    # BAD: one arm issues a psum, the other none — both trace into the
+    # same program, so the sequences must match
+    first = lax.axis_index("data") == 0
+    return lax.cond(first, _arm_psum, _arm_plain, x)
+
+
+def cond_matching_ok(x):
+    # OK: both arms issue the identical collective sequence
+    first = lax.axis_index("data") == 0
+    return lax.cond(first, _arm_psum, _arm_psum_too, x)
+
+
+def switch_unverifiable_bad(x, branches):
+    # BAD: rank-selected switch over callables the analysis cannot resolve
+    idx = lax.axis_index("data")
+    return lax.switch(idx, branches, x)
+
+
+def switch_unverifiable_suppressed(x, branches):
+    idx = lax.axis_index("data")
+    return lax.switch(idx, branches, x)  # graftlint: disable=collective-consistency

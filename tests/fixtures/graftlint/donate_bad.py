@@ -1,0 +1,117 @@
+"""graftlint fixture: use-after-donate true positives / good shapes.
+
+Lives at the fixture-package top level (NOT under ``nn/``) so the donating
+jits here don't also trip step-wiring — each fixture file exercises one
+rule family.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.step_program import StepProgram
+
+
+def _body(params, opt, state, x):
+    return params, opt, state, x.sum()
+
+
+def _body1(params, x):
+    return params, x.sum()
+
+
+_jstep = jax.jit(_body, donate_argnums=(0, 1))
+_jstep1 = jax.jit(_body1, donate_argnums=(0,))
+
+
+def read_after_donate(params, opt, state, x):
+    # BAD: params donated into _jstep, read afterwards
+    new_p, new_o, new_s, loss = _jstep(params, opt, state, x)
+    norm = jnp.sum(params["w"])
+    return new_p, norm
+
+
+def rebind_ok(params, opt, state, x):
+    # OK: the donated carry is rebound from the outputs, same statement
+    params, opt, state, loss = _jstep(params, opt, state, x)
+    return params, loss
+
+
+def barrier_ok(params, opt, state, x):
+    # OK: explicit barrier pins the value before the later read
+    new_p, new_o, new_s, loss = _jstep(params, opt, state, x)
+    jax.block_until_ready(params)
+    return new_p, jnp.sum(params["w"])
+
+
+def read_suppressed(params, opt, state, x):
+    new_p, new_o, new_s, loss = _jstep(params, opt, state, x)
+    norm = jnp.sum(params["w"])  # graftlint: disable=use-after-donate
+    return new_p, norm
+
+
+def loop_carry_bad(params, opt, state, xs):
+    # BAD: donated carry never rebound; iteration 2 dispatches dead buffers
+    for x in xs:
+        out = _jstep(params, opt, state, x)
+    return out
+
+
+def loop_carry_ok(params, opt, state, xs):
+    # OK: the carry threads through the loop
+    for x in xs:
+        params, opt, state, loss = _jstep(params, opt, state, x)
+    return params, loss
+
+
+def alias_bad(model, x):
+    # BAD: lp aliases model.params; donating lp kills the buffer still
+    # reachable through model.params
+    lp = model.params
+    lp, loss = _jstep1(lp, x)
+    return model.params, loss
+
+
+def alias_copy_ok(model, x):
+    # OK: the copy severs the alias before the donated chain starts
+    lp = jax.tree_util.tree_map(jnp.copy, model.params)
+    lp, loss = _jstep1(lp, x)
+    return model.params, loss
+
+
+def _helper_step(params, opt, state, x):
+    # donates its params/opt positional args into _jstep
+    p, o, s, loss = _jstep(params, opt, state, x)
+    return p, o, s, loss
+
+
+def interproc_bad(params, opt, state, x):
+    # BAD: _helper_step's summary says params/opt die in there
+    _helper_step(params, opt, state, x)
+    return params
+
+
+def interproc_ok(params, opt, state, x):
+    # OK: rebound from the helper's outputs
+    params, opt, state, loss = _helper_step(params, opt, state, x)
+    return params
+
+
+class Trainer:
+    """Field-sensitivity: the donating program lives on ``self._step``."""
+
+    def __init__(self, body, x0):
+        self._step = StepProgram(body, "fixture.step")  # donates (0, 1, 2)
+        self.params = {"w": x0}
+        self.opt = {}
+        self.state = {}
+
+    def fit_bad(self, x):
+        # BAD: self.params donated via self._step.dispatch, then read
+        out = self._step.dispatch(self.params, self.opt, self.state, x)
+        return jnp.sum(self.params["w"])
+
+    def fit_ok(self, x):
+        # OK: the attr carry rebinds in the dispatch statement
+        self.params, self.opt, self.state, loss = self._step.dispatch(
+            self.params, self.opt, self.state, x)
+        return loss

@@ -1,0 +1,73 @@
+"""graftlint fixture: durable-store-protocol true positives / good shapes.
+
+Writes reaching durable paths (checkpoint/bundle/lease/blob/weights...)
+must go through the write-tmp-then-``os.replace`` discipline (or
+``os.link`` for exclusive create); raw writes tear under crash/preemption.
+"""
+
+import json
+import os
+
+import numpy as np
+
+
+def save_bad(state, outdir):
+    # BAD: raw open(..., "w") on a checkpoint path
+    path = os.path.join(outdir, "checkpoint_0001.bin")
+    with open(path, "w") as f:
+        json.dump(state, f)
+
+
+def save_np_bad(arr, outdir):
+    # BAD: np.save straight onto a weights path
+    np.save(os.path.join(outdir, "weights_final.npy"), arr)
+
+
+def exclusive_bad(outdir):
+    # BAD: open(..., "x") is not atomic on NFS; spell os.link
+    lease = os.path.join(outdir, "lease_owner")
+    with open(lease, "x") as f:
+        f.write("me")
+
+
+def _write_raw(path, payload):
+    # BAD through the caller's taint: path carries a bundle marker there
+    with open(path, "w") as f:
+        f.write(payload)
+
+
+def save_via_helper(payload, outdir):
+    _write_raw(os.path.join(outdir, "bundle_main.json"), payload)
+
+
+def save_good(state, outdir):
+    # OK: tmp write + fsync + os.replace — the sanctioned discipline
+    path = os.path.join(outdir, "checkpoint_0001.bin")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def exclusive_good(outdir):
+    # OK: exclusive create via hard link is atomic on POSIX and NFS
+    lease = os.path.join(outdir, "lease_owner")
+    tmp = lease + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("me")
+    os.link(tmp, lease)
+    os.unlink(tmp)
+
+
+def save_suppressed(state, outdir):
+    path = os.path.join(outdir, "checkpoint_scratch.bin")
+    with open(path, "w") as f:  # graftlint: disable=durable-store-protocol
+        json.dump(state, f)
+
+
+def transient_ok(rows, outdir):
+    # OK: no durable marker anywhere — not this rule's business
+    with open(os.path.join(outdir, "log.txt"), "w") as f:
+        f.write("\n".join(rows))

@@ -1,12 +1,18 @@
 """graftlint: per-rule true positives on fixtures, suppressions, baseline
-workflow, full-package-clean, and the runtime retrace guard (ISSUE 2)."""
+workflow, full-package-clean, the runtime retrace guard (ISSUE 2), and the
+distributed-correctness layer — dataflow engine, use-after-donate /
+collective-consistency / durable-store-protocol rules, --changed scoping,
+SARIF output, and the runtime donation guard (ISSUE 17)."""
 
 import json
 import os
+import shutil
+import subprocess
 
 import numpy as np
 import pytest
 
+from deeplearning4j_tpu.analysis import donation_guard
 from deeplearning4j_tpu.analysis import lint as lint_mod
 from deeplearning4j_tpu.analysis import retrace_guard
 from deeplearning4j_tpu.analysis import rules as rules_mod
@@ -23,10 +29,11 @@ def _clean_env(monkeypatch):
     for var in ("DL4J_TPU_BUCKETING", "DL4J_TPU_BUCKETS",
                 "DL4J_TPU_BUCKET_MIN", "DL4J_TPU_BUCKET_GROWTH",
                 "DL4J_TPU_DEVICE_PREFETCH", "DL4J_TPU_RETRACE_GUARD",
-                "DL4J_TPU_STRICT_RETRACE"):
+                "DL4J_TPU_STRICT_RETRACE", "DL4J_TPU_DONATION_GUARD"):
         monkeypatch.delenv(var, raising=False)
     bucketing.telemetry().reset()
     retrace_guard.reset_warnings()
+    donation_guard.reset_warnings()
     yield
 
 
@@ -155,8 +162,168 @@ class TestRuleTruePositives:
              "step_suppressed"),
             ("tuner-off-hot-path", "tuner_bad.py", "fit_suppressed"),
             ("step-wiring", "step_wiring_bad.py", "make_step_suppressed"),
+            ("use-after-donate", "donate_bad.py", "read_suppressed"),
+            ("collective-consistency", "collective_bad.py",
+             "ranky_suppressed"),
+            ("collective-consistency", "collective_bad.py",
+             "switch_unverifiable_suppressed"),
+            ("durable-store-protocol", "store_bad.py", "save_suppressed"),
         ):
             assert not _hits(fs, rule, filename, func), (rule, func)
+
+
+# ---------------------------------------------------------------------------
+# distributed-correctness rule families (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+
+class TestUseAfterDonate:
+    RULE = "use-after-donate"
+
+    def test_read_after_donate(self, fixture_findings):
+        hits = _hits(fixture_findings, self.RULE, "donate_bad.py",
+                     "read_after_donate")
+        assert hits and "donated" in hits[0].message
+
+    def test_loop_carry(self, fixture_findings):
+        hits = _hits(fixture_findings, self.RULE, "donate_bad.py",
+                     "loop_carry_bad")
+        assert hits and "loop" in hits[0].message
+
+    def test_alias_kills_base(self, fixture_findings):
+        hits = _hits(fixture_findings, self.RULE, "donate_bad.py",
+                     "alias_bad")
+        assert hits and "model.params" in hits[0].message
+
+    def test_interprocedural_summary(self, fixture_findings):
+        hits = _hits(fixture_findings, self.RULE, "donate_bad.py",
+                     "interproc_bad")
+        assert hits and "_helper_step" in hits[0].message
+
+    def test_field_sensitive_self_attr(self, fixture_findings):
+        hits = _hits(fixture_findings, self.RULE, "donate_bad.py",
+                     "Trainer.fit_bad")
+        assert hits and "self.params" in hits[0].message
+
+    def test_good_shapes_stay_clean(self, fixture_findings):
+        for func in ("rebind_ok", "barrier_ok", "loop_carry_ok",
+                     "alias_copy_ok", "interproc_ok", "Trainer.fit_ok"):
+            assert not _hits(fixture_findings, self.RULE, "donate_bad.py",
+                             func), func
+
+
+class TestCollectiveConsistency:
+    RULE = "collective-consistency"
+
+    def test_rank_dependent_collective(self, fixture_findings):
+        hits = _hits(fixture_findings, self.RULE, "collective_bad.py",
+                     "ranky_bad")
+        assert hits and "rank-dependent" in hits[0].message
+
+    def test_axis_not_bound_by_shard_map(self, fixture_findings):
+        hits = _hits(fixture_findings, self.RULE, "collective_bad.py",
+                     "_step_wrong_axis")
+        assert hits and "'model'" in hits[0].message
+
+    def test_duplicate_axis(self, fixture_findings):
+        hits = _hits(fixture_findings, self.RULE, "collective_bad.py",
+                     "_step_dup_axis")
+        assert hits and "repeats" in hits[0].message
+
+    def test_divergent_cond_arms(self, fixture_findings):
+        hits = _hits(fixture_findings, self.RULE, "collective_bad.py",
+                     "cond_divergent_bad")
+        assert hits and "different collective sequences" in hits[0].message
+
+    def test_unresolvable_rank_selected_switch(self, fixture_findings):
+        hits = _hits(fixture_findings, self.RULE, "collective_bad.py",
+                     "switch_unverifiable_bad")
+        assert hits and "statically" in hits[0].message
+
+    def test_good_shapes_stay_clean(self, fixture_findings):
+        for func in ("_step_ok", "ranky_hoisted_ok", "cond_matching_ok"):
+            assert not _hits(fixture_findings, self.RULE,
+                             "collective_bad.py", func), func
+
+
+class TestDurableStoreProtocol:
+    RULE = "durable-store-protocol"
+
+    def test_raw_open_w(self, fixture_findings):
+        hits = _hits(fixture_findings, self.RULE, "store_bad.py", "save_bad")
+        assert hits and "os.replace" in hits[0].message
+
+    def test_np_save(self, fixture_findings):
+        hits = _hits(fixture_findings, self.RULE, "store_bad.py",
+                     "save_np_bad")
+        assert hits and "not atomic" in hits[0].message
+
+    def test_exclusive_create_spelling(self, fixture_findings):
+        hits = _hits(fixture_findings, self.RULE, "store_bad.py",
+                     "exclusive_bad")
+        assert hits and "os.link" in hits[0].message
+
+    def test_interprocedural_path_taint(self, fixture_findings):
+        # the helper itself writes; the durable marker is in its CALLER
+        hits = _hits(fixture_findings, self.RULE, "store_bad.py",
+                     "_write_raw")
+        assert hits
+
+    def test_good_shapes_stay_clean(self, fixture_findings):
+        for func in ("save_good", "exclusive_good", "transient_ok"):
+            assert not _hits(fixture_findings, self.RULE, "store_bad.py",
+                             func), func
+
+
+class TestDataflow:
+    """Unit tests on the interprocedural field-sensitive layer itself."""
+
+    @pytest.fixture(scope="class")
+    def df(self):
+        return Index(FIXTURES).dataflow
+
+    def test_param_donation_summary(self, df):
+        # _helper_step forwards its params/opt positional args into a
+        # donating jit -> interprocedural summary says params 0 and 1 die
+        q = "graftlint.donate_bad::_helper_step"
+        assert sorted(df.param_donations[q]) == [0, 1]
+
+    def test_field_sensitive_class_attr(self, df):
+        # Trainer.__init__ binds self._step to a default-donating
+        # StepProgram; the per-class attr table carries it
+        table = df.class_attr_donations[("graftlint.donate_bad", "Trainer")]
+        assert table["_step"].positions == (0, 1, 2)
+
+    def test_global_donation_binding(self, df):
+        don = df.global_donations[("graftlint.donate_bad", "_jstep")]
+        assert don.positions == (0, 1)
+
+    def test_durable_param_taint_crosses_calls(self, df):
+        # save_via_helper passes a bundle-marked path into _write_raw
+        q = "graftlint.store_bad::_write_raw"
+        assert 0 in df.durable_params[q]
+
+    def test_dispatch_site_keys(self, df):
+        idx = df.index
+        fi = idx.functions["graftlint.donate_bad::Trainer.fit_bad"]
+        (site,) = df.dispatch_sites(fi)
+        assert [(p, k) for p, k, _ in site.donated] == [
+            (0, ("attr", "self", "params")),
+            (1, ("attr", "self", "opt")),
+            (2, ("attr", "self", "state")),
+        ]
+
+    def test_non_literal_donate_argnums_skipped(self, tmp_path):
+        # a computed donate spec must not be guessed at
+        pkg = tmp_path / "p"
+        pkg.mkdir()
+        (pkg / "m.py").write_text(
+            "import jax\n\n"
+            "def f(a, b):\n    return a + b\n\n"
+            "def make(donate):\n"
+            "    return jax.jit(f, donate_argnums=(0,) if donate else ())\n")
+        df = Index(str(pkg)).dataflow
+        assert "p.m::make" not in df.factory_returns
 
 
 # ---------------------------------------------------------------------------
@@ -220,6 +387,217 @@ class TestCli:
         # shift every line down: same finding, different line number
         (pkg / "m.py").write_text("# a comment\n# another\n" + src)
         assert lint_mod.main([str(pkg), "--baseline", bl]) == 0
+
+
+_VIOLATION_SRC = (
+    "import time\n\n"
+    "def age(t0):\n"
+    "    return time.time() - t0\n")
+
+
+class TestChangedScope:
+    """--changed: only findings in git-modified/untracked files can fail."""
+
+    @pytest.fixture()
+    def repo(self, tmp_path):
+        if shutil.which("git") is None:
+            pytest.skip("git unavailable")
+        env = dict(os.environ,
+                   GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+                   GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t")
+
+        def git(*args):
+            subprocess.run(["git", "-C", str(tmp_path)] + list(args),
+                           check=True, capture_output=True, env=env)
+
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        # a committed file that already violates monotonic-clock
+        (pkg / "old.py").write_text(_VIOLATION_SRC)
+        git("init", "-q")
+        git("add", "-A")
+        git("commit", "-q", "-m", "seed")
+        return pkg, git
+
+    def test_only_changed_files_can_fail(self, repo, capsys):
+        pkg, git = repo
+        # clean tree: the committed violation is out of scope
+        assert lint_mod.main([str(pkg), "--no-baseline", "--changed"]) == 0
+        capsys.readouterr()
+        # an untracked violating file IS in scope
+        (pkg / "new.py").write_text(_VIOLATION_SRC.replace("age", "lag"))
+        assert lint_mod.main([str(pkg), "--no-baseline", "--changed"]) == 1
+        out = capsys.readouterr().out
+        assert "new.py" in out and "old.py" not in out
+        # once committed, the tree is quiet again on the pre-commit path
+        git("add", "-A")
+        git("commit", "-q", "-m", "more")
+        assert lint_mod.main([str(pkg), "--no-baseline", "--changed"]) == 0
+
+    def test_changed_outside_a_repo_is_a_usage_error(self, tmp_path):
+        pkg = tmp_path / "norepo"
+        pkg.mkdir()
+        (pkg / "m.py").write_text("x = 1\n")
+        assert lint_mod.main([str(pkg), "--changed"]) == 2
+
+    def test_fix_baseline_rejects_changed(self, repo):
+        pkg, _git = repo
+        assert lint_mod.main([str(pkg), "--changed", "--fix-baseline"]) == 2
+
+
+# Enough of the SARIF 2.1.0 schema to catch structural regressions without
+# vendoring the full OASIS document.
+_SARIF_SCHEMA = {
+    "type": "object",
+    "required": ["version", "runs"],
+    "properties": {
+        "version": {"const": "2.1.0"},
+        "runs": {
+            "type": "array", "minItems": 1,
+            "items": {
+                "type": "object",
+                "required": ["tool", "results"],
+                "properties": {
+                    "tool": {
+                        "type": "object", "required": ["driver"],
+                        "properties": {"driver": {
+                            "type": "object", "required": ["name", "rules"],
+                            "properties": {"rules": {
+                                "type": "array",
+                                "items": {
+                                    "type": "object",
+                                    "required": ["id"],
+                                }}}}},
+                    },
+                    "results": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "required": ["ruleId", "level", "message",
+                                         "locations"],
+                            "properties": {
+                                "level": {"enum": ["error", "note",
+                                                   "warning", "none"]},
+                                "message": {
+                                    "type": "object",
+                                    "required": ["text"],
+                                },
+                                "baselineState": {
+                                    "enum": ["new", "unchanged", "updated",
+                                             "absent"]},
+                                "locations": {
+                                    "type": "array", "minItems": 1},
+                            },
+                        },
+                    },
+                },
+            },
+        },
+    },
+}
+
+
+class TestSarif:
+    def test_sarif_log_is_valid_and_marks_new(self, tmp_path):
+        jsonschema = pytest.importorskip("jsonschema")
+        out = tmp_path / "out.sarif"
+        assert lint_mod.main([FIXTURES, "--no-baseline",
+                              "--sarif", str(out)]) == 1
+        doc = json.loads(out.read_text())
+        jsonschema.validate(doc, _SARIF_SCHEMA)
+        results = doc["runs"][0]["results"]
+        assert results
+        assert all(r["level"] == "error" and r["baselineState"] == "new"
+                   for r in results)
+        rule_ids = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+        assert {r["ruleId"] for r in results} <= rule_ids
+        assert all(r["partialFingerprints"]["graftlint/v1"]
+                   for r in results)
+
+    def test_sarif_grandfathered_are_notes(self, tmp_path):
+        jsonschema = pytest.importorskip("jsonschema")
+        bl = str(tmp_path / "bl.json")
+        assert lint_mod.main([FIXTURES, "--baseline", bl,
+                              "--fix-baseline"]) == 0
+        out = tmp_path / "out.sarif"
+        assert lint_mod.main([FIXTURES, "--baseline", bl,
+                              "--sarif", str(out)]) == 0
+        doc = json.loads(out.read_text())
+        jsonschema.validate(doc, _SARIF_SCHEMA)
+        results = doc["runs"][0]["results"]
+        assert results
+        assert all(r["level"] == "note" and r["baselineState"] == "unchanged"
+                   for r in results)
+
+
+class TestDonationGuard:
+    """DL4J_TPU_DONATION_GUARD=1 poisons donated host refs after dispatch.
+
+    The guard exists for backends that IGNORE ``donate_argnums`` (the leaf
+    survives and a use-after-donate silently reads stale data). XLA:CPU
+    honors donation when an output can reuse the buffer, so the tests force
+    the forgiving path with a donated input whose shape matches no output —
+    the backend must leave it alive, and the guard must kill it.
+    """
+
+    @staticmethod
+    def _program():
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.step_program import StepProgram
+
+        def body(params, opt, state, x):
+            # output "w" is (2,); the donated (5,) input can't be reused
+            return ({"w": params["w"][:2]}, opt, state,
+                    jnp.sum(params["w"]))
+
+        return StepProgram(body, "test.guard", aot_wrap=False), jnp
+
+    def test_check_after_dispatch_poisons_live_leaf(self, monkeypatch):
+        import jax.numpy as jnp
+        arr = jnp.ones((3,))
+        before = donation_guard._trips.value()
+        monkeypatch.setenv("DL4J_TPU_DONATION_GUARD", "1")
+        trips = donation_guard.check_after_dispatch(
+            "unit.site", [{"w": arr}], (0,), outputs=jnp.zeros(()))
+        assert [t.position for t in trips] == [0]
+        assert trips[0].shape == (3,)
+        assert arr.is_deleted()
+        assert donation_guard._trips.value() == before + 1
+        # second sweep over the same (now dead) leaf is a no-op
+        assert donation_guard.check_after_dispatch(
+            "unit.site", [{"w": arr}], (0,), outputs=jnp.zeros(())) == []
+
+    @pytest.mark.filterwarnings("ignore:Some donated buffers")
+    def test_guard_poisons_through_step_program(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_DONATION_GUARD", "1")
+        prog, jnp = self._program()
+        params = {"w": jnp.ones((5,))}
+        leaf = params["w"]
+        before = donation_guard._trips.value()
+        new_p, opt, state, loss = prog(params, {}, {}, jnp.ones((4,)))
+        assert leaf.is_deleted()
+        assert donation_guard._trips.value() > before
+        # outputs stay usable: the guard only kills the donated INPUT refs
+        assert float(loss) == 5.0
+        with pytest.raises(RuntimeError):
+            float(leaf[0])
+
+    @pytest.mark.filterwarnings("ignore:Some donated buffers")
+    def test_guard_off_by_default(self):
+        prog, jnp = self._program()
+        params = {"w": jnp.ones((5,))}
+        leaf = params["w"]
+        before = donation_guard._trips.value()
+        prog(params, {}, {}, jnp.ones((4,)))
+        # the backend couldn't reuse the buffer and nobody poisoned it:
+        # exactly the silent-survival mode the guard exists to expose
+        assert not leaf.is_deleted()
+        assert donation_guard._trips.value() == before
+
+    def test_guard_zero_disables(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_DONATION_GUARD", "0")
+        assert not donation_guard.enabled()
 
 
 # ---------------------------------------------------------------------------

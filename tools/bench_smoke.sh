@@ -8,6 +8,34 @@ cd "$(dirname "$0")/.."
 out=$(mktemp)
 out2=$(mktemp)
 trap 'rm -f "$out" "$out2"' EXIT
+
+# graftlint exit-code contract (docs/LINT.md): the tree must lint clean vs
+# the checked-in baseline (0), a bad rule name must be a usage error (2),
+# and a genuine violation must still FAIL (1) — i.e. the rule expansion
+# didn't silently neuter the gate. Lint clean stays a release gate.
+tools/lint.sh
+rc=0; tools/lint.sh --rules no-such-rule >/dev/null 2>&1 || rc=$?
+if [ "$rc" -ne 2 ]; then
+    echo "bench smoke: lint.sh --rules no-such-rule exited $rc, expected 2" >&2
+    exit 1
+fi
+lintdir=$(mktemp -d)
+trap 'rm -f "$out" "$out2"; rm -rf "$lintdir"' EXIT
+cat > "$lintdir/clockly.py" <<'PYEOF'
+import time
+
+def elapsed(t0):
+    return time.time() - t0
+PYEOF
+rc=0
+python -m deeplearning4j_tpu.analysis.lint "$lintdir" --no-baseline \
+    >/dev/null 2>&1 || rc=$?
+if [ "$rc" -ne 1 ]; then
+    echo "bench smoke: lint.sh missed a planted violation (exit $rc, expected 1)" >&2
+    exit 1
+fi
+echo "bench smoke OK: graftlint clean, exit-code contract (0/1/2) holds"
+
 BENCH_SMOKE=1 JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python bench.py | tee "$out"
 
 # every registered metric present, none carrying an "error" field, and every

@@ -1,0 +1,17 @@
+#!/bin/bash
+# graftlint pre-commit hook: lint only the files git reports as modified or
+# untracked (the full index is still built — the interprocedural rules need
+# it — but only findings in changed files can fail the commit).
+#
+# Install:
+#   ln -sf ../../tools/pre-commit.sh .git/hooks/pre-commit
+#
+# Exit codes follow the tools/lint.sh contract: 0 lets the commit through,
+# 1 blocks it on new findings in your changes, 2 is a usage/parse/git error
+# (also blocks — a broken linter should never wave code past). Bypass a
+# false positive with an inline `# graftlint: disable=<rule>` plus a
+# one-line justification, not with `git commit --no-verify`.
+set -u
+# resolve through the .git/hooks symlink back to tools/
+self=$(readlink -f "$0")
+exec "$(dirname "$self")/lint.sh" --changed "$@"
