@@ -73,6 +73,12 @@ DURABLE_PATH_MARKERS = (
     ".npz",
 )
 
+# Modules whose functions are protocol-safe sinks for durable names: they
+# frame/CRC payloads end-to-end themselves (the netstore client speaks the
+# same DLES framing as FileStore), so a durable key flowing into them is the
+# protocol being honored, not bypassed. Durable-param taint stops here.
+PROTOCOL_SAFE_SINK_MODULES = ("netstore",)
+
 
 def key_of(expr: ast.AST) -> Optional[Key]:
     """The tracking key of an expression, or None for anything more complex
@@ -414,6 +420,9 @@ class Dataflow:
                         if not self.expr_durable(fi, arg, durable_names):
                             continue
                         for callee in callees:
+                            mod = callee.split("::", 1)[0].rsplit(".", 1)[-1]
+                            if mod in PROTOCOL_SAFE_SINK_MODULES:
+                                continue
                             cfi = self.index.functions.get(callee)
                             if cfi is None or isinstance(cfi.node, ast.Module):
                                 continue

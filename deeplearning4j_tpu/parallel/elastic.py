@@ -51,6 +51,7 @@ import os
 import struct
 import threading
 import time
+import warnings
 import zlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -87,6 +88,14 @@ def elastic_knobs() -> dict:
 _MAGIC = b"DLES"
 _HEADER = struct.Struct("<4sIQ")  # magic, crc32(payload), payload length
 
+_LINK_FALLBACK_WARNED = False
+
+
+def _count_rpc(op: str, backend: str) -> None:
+    obs.counter("dl4j_store_rpc_total",
+                "Coordination-store operations by op and backend",
+                ("op", "backend")).inc(op=op, backend=backend)
+
 
 class FileStore:
     """Shared coordination/payload store.
@@ -95,7 +104,13 @@ class FileStore:
     write-to-tempfile + ``os.replace`` (or ``os.link`` for exclusive
     creates), so a reader sees either nothing or a whole, checksummed record
     — never a torn write. Keys are slash-separated paths under ``root``.
+
+    The same interface (plus :meth:`watch`) is implemented over TCP by
+    ``parallel/netstore.NetStore``; pick a backend with
+    ``parallel.netstore.open_store`` / ``DL4J_TPU_STORE``.
     """
+
+    backend = "file"
 
     def __init__(self, root):
         self.root = os.fspath(root)
@@ -119,6 +134,7 @@ class FileStore:
     # -- writes -------------------------------------------------------------
     def set(self, key: str, data: bytes) -> None:
         """Last-writer-wins atomic put (leases, payloads, manifests)."""
+        _count_rpc("set", self.backend)
         path = self._path(key)
         tmp = self._tmp(path)
         with open(tmp, "wb") as f:
@@ -130,26 +146,56 @@ class FileStore:
     def set_exclusive(self, key: str, data: bytes) -> bool:
         """First-writer-wins atomic put (view proposals). Returns True when
         THIS call created the record — the link is atomic, so exactly one of
-        any number of concurrent proposers wins."""
+        any number of concurrent proposers wins. Filesystems without
+        hardlinks (FAT, some NFS exports) fall back to an ``O_EXCL``
+        create: exclusivity holds, but the record is written in place, so a
+        concurrent reader can catch it half-written — the CRC frame makes
+        that read as missing, and the reader retries."""
+        _count_rpc("setx", self.backend)
         path = self._path(key)
         tmp = self._tmp(path)
+        framed = self._frame(data)
         with open(tmp, "wb") as f:
-            f.write(self._frame(data))
+            f.write(framed)
             f.flush()
             os.fsync(f.fileno())
         try:
-            os.link(tmp, path)
-            return True
-        except FileExistsError:
-            return False
+            try:
+                os.link(tmp, path)
+                return True
+            except FileExistsError:
+                return False
+            except OSError:
+                return self._set_exclusive_o_excl(path, framed)
         finally:
             os.unlink(tmp)
+
+    def _set_exclusive_o_excl(self, path: str, framed: bytes) -> bool:
+        global _LINK_FALLBACK_WARNED
+        if not _LINK_FALLBACK_WARNED:
+            _LINK_FALLBACK_WARNED = True
+            warnings.warn(
+                f"FileStore at {self.root!r}: os.link unsupported; exclusive "
+                f"creates fall back to O_EXCL (exclusivity preserved, "
+                f"in-place write guarded by CRC framing)",
+                RuntimeWarning, stacklevel=3)
+            obs.event("elastic_store_link_fallback", root=self.root)
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        with os.fdopen(fd, "wb") as f:
+            f.write(framed)
+            f.flush()
+            os.fsync(f.fileno())
+        return True
 
     # -- reads --------------------------------------------------------------
     def get(self, key: str) -> Optional[bytes]:
         """The record's payload, or None when missing. A record failing its
         CRC (torn external copy, disk fault) counts + reads as missing
         rather than poisoning the consumer."""
+        _count_rpc("get", self.backend)
         path = os.path.join(self.root, key)
         try:
             with open(path, "rb") as f:
@@ -169,13 +215,16 @@ class FileStore:
     def _corrupt(self, key: str, why: str) -> None:
         obs.counter("dl4j_elastic_store_corrupt_total",
                     "FileStore records failing frame/CRC validation").inc()
-        obs.event("elastic_store_corrupt", key=key, reason=why)
+        obs.event("elastic_store_corrupt", key=key, reason=why,
+                  backend=self.backend)
         return None
 
     def exists(self, key: str) -> bool:
+        _count_rpc("exists", self.backend)
         return os.path.isfile(os.path.join(self.root, key))
 
     def delete(self, key: str) -> None:
+        _count_rpc("delete", self.backend)
         try:
             os.unlink(os.path.join(self.root, key))
         except FileNotFoundError:
@@ -188,10 +237,12 @@ class FileStore:
         miss and falls into its normal wait path."""
         import shutil
 
+        _count_rpc("prune", self.backend)
         shutil.rmtree(os.path.join(self.root, prefix), ignore_errors=True)
 
     def list(self, prefix: str) -> List[str]:
         """Sorted record names directly under the ``prefix`` directory."""
+        _count_rpc("list", self.backend)
         d = os.path.join(self.root, prefix)
         try:
             names = os.listdir(d)
@@ -199,6 +250,53 @@ class FileStore:
             return []
         return sorted(n for n in names if not n.endswith(".tmp")
                       and ".tmp." not in n)
+
+    # -- watch ---------------------------------------------------------------
+    def _fingerprint(self, prefix: str) -> Tuple:
+        """State token for :meth:`watch`: (name, mtime_ns, size) of the
+        entries directly under ``prefix``. Renaming a record into a
+        subdirectory bumps that subdirectory's mtime, so watching ``""``
+        observes changes anywhere in the tree one level down."""
+        d = os.path.join(self.root, prefix) if prefix else self.root
+        entries = []
+        try:
+            with os.scandir(d) as it:
+                for e in it:
+                    if e.name.endswith(".tmp") or ".tmp." in e.name:
+                        continue
+                    try:
+                        st = e.stat()
+                    except OSError:
+                        continue
+                    entries.append((e.name, st.st_mtime_ns, st.st_size))
+        except (FileNotFoundError, NotADirectoryError):
+            pass
+        return tuple(sorted(entries))
+
+    def watch(self, prefix: str, token=None, timeout: float = 1.0):
+        """Block until something under ``prefix`` changes relative to
+        ``token`` (or ``timeout`` elapses); returns the new opaque token.
+        ``token=None`` returns the current token without waiting. The
+        file backend polls directory fingerprints; the TCP backend long-
+        polls a server revision — same contract, so membership waits are
+        backend-agnostic."""
+        _count_rpc("watch", self.backend)
+        t0 = time.monotonic()
+        cur = self._fingerprint(prefix)
+        if token is None:
+            return cur
+        deadline = t0 + max(0.0, float(timeout))
+        step = min(max(float(timeout) / 10.0, 0.005), 0.05)
+        while cur == token:
+            now = time.monotonic()
+            if now >= deadline:
+                break
+            time.sleep(min(step, deadline - now))
+            cur = self._fingerprint(prefix)
+        obs.histogram("dl4j_store_watch_wait_seconds",
+                      "Time spent blocked in store watch calls").observe(
+                          time.monotonic() - t0)
+        return cur
 
     # -- JSON convenience ---------------------------------------------------
     def set_json(self, key: str, value: dict) -> None:
@@ -233,11 +331,12 @@ class Membership:
     """
 
     def __init__(self, store: FileStore, wid: str, *, ttl: float,
-                 poll: float):
+                 poll: float, rack: str = ""):
         self.store = store
         self.wid = wid
         self.ttl = float(ttl)
         self.poll = float(poll)
+        self.rack = str(rack)
         self.incarnation = f"{os.getpid()}.{int(time.time() * 1e6)}"  # graftlint: disable=monotonic-clock
         self._stop = threading.Event()
         self._suspend_until = 0.0       # monotonic deadline; 0 = not suspended
@@ -251,6 +350,7 @@ class Membership:
             "ts": time.time(),  # graftlint: disable=monotonic-clock
             "ttl": self.ttl,
             "inc": self.incarnation,
+            "rack": self.rack,
         })
 
     def _fresh(self, lease: Optional[dict]) -> bool:
@@ -272,18 +372,31 @@ class Membership:
                 daemon=True)
             self._thread.start()
 
-    def leave(self) -> None:
+    def leave(self, timeout: Optional[float] = None) -> None:
+        """Stop heartbeating, join the thread with a deadline (a heartbeat
+        mid-RPC against an unreachable store can take up to its retry
+        budget), then drop the lease best-effort."""
         self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=2 * self.poll + 1.0)
-        self.store.delete(f"lease/{self.wid}")
+            self._thread.join(timeout=(2 * self.poll + 1.0)
+                              if timeout is None else float(timeout))
+            if self._thread.is_alive():
+                obs.event("elastic_heartbeat_leak", wid=self.wid)
+            self._thread = None
+        try:
+            self.store.delete(f"lease/{self.wid}")
+        except OSError:
+            pass  # store already gone; the lease will expire on its own
 
     def _heartbeat_loop(self) -> None:
         interval = max(self.ttl / 4.0, self.poll)
         while not self._stop.wait(interval):
+            # check-and-renew under the lock: a suspend() landing between an
+            # unlocked check and the write would be overridden by a renewal,
+            # un-partitioning the worker mid-fault
             with self._lock:
-                suspended = time.monotonic() < self._suspend_until
-            if not suspended:
+                if time.monotonic() < self._suspend_until:
+                    continue
                 try:
                     self._write_lease()
                 except OSError:
@@ -292,9 +405,9 @@ class Membership:
                     pass
 
     def suspend(self, seconds: float) -> None:
-        """Stop renewing the lease for ``seconds`` (the net_partition chaos
-        fault). The worker process keeps running; to the rest of the group
-        it looks exactly like a network partition."""
+        """Stop renewing the lease for ``seconds`` (the net_partition /
+        rack_partition chaos faults). The worker process keeps running; to
+        the rest of the group it looks exactly like a network partition."""
         with self._lock:
             self._suspend_until = time.monotonic() + float(seconds)
 
@@ -303,7 +416,7 @@ class Membership:
         does not wait for the next thread tick)."""
         with self._lock:
             self._suspend_until = 0.0
-        self._write_lease()
+            self._write_lease()
 
     # -- group queries -------------------------------------------------------
     def lease(self, wid: str) -> Optional[dict]:
@@ -349,6 +462,11 @@ class View:
     rejoined: Tuple[str, ...] = ()
     incs: Dict[str, str] = field(default_factory=dict)
     prev_incs: Dict[str, str] = field(default_factory=dict)
+    # rack labels per member (DL4J_TPU_RACK), recorded at proposal time so
+    # every member derives the SAME rack-aware mirror placement; prev_racks
+    # keeps the outgoing geometry for handoff (mirrors of the old view)
+    racks: Dict[str, str] = field(default_factory=dict)
+    prev_racks: Dict[str, str] = field(default_factory=dict)
 
     @property
     def world(self) -> int:
@@ -377,6 +495,7 @@ class View:
             "step": self.step, "iteration": self.iteration,
             "reason": self.reason, "rejoined": list(self.rejoined),
             "incs": dict(self.incs), "prev_incs": dict(self.prev_incs),
+            "racks": dict(self.racks), "prev_racks": dict(self.prev_racks),
         }
 
     @staticmethod
@@ -389,7 +508,9 @@ class View:
             reason=str(d.get("reason", "")),
             rejoined=tuple(d.get("rejoined", ())),
             incs=dict(d.get("incs", {})),
-            prev_incs=dict(d.get("prev_incs", {})))
+            prev_incs=dict(d.get("prev_incs", {})),
+            racks=dict(d.get("racks", {})),
+            prev_racks=dict(d.get("prev_racks", {})))
 
 
 class MembershipChanged(Exception):
@@ -411,15 +532,18 @@ class ElasticRuntime:
     """Membership + view agreement for one worker of an elastic group."""
 
     def __init__(self, store: FileStore, wid: str, *,
-                 ttl: Optional[float] = None, poll: Optional[float] = None):
+                 ttl: Optional[float] = None, poll: Optional[float] = None,
+                 rack: Optional[str] = None):
         knobs = elastic_knobs()
         self.store = store
         self.wid = wid
         self.ttl = float(knobs["ttl_s"] if ttl is None else ttl)
         self.poll = float(knobs["poll_s"] if poll is None else poll)
         self.wait_timeout = float(knobs["wait_timeout_s"])
+        self.rack = str(os.environ.get("DL4J_TPU_RACK", "")
+                        if rack is None else rack)
         self.membership = Membership(store, wid, ttl=self.ttl,
-                                     poll=self.poll)
+                                     poll=self.poll, rack=self.rack)
         self.view: Optional[View] = None
 
     # -- store-side view helpers -------------------------------------------
@@ -437,6 +561,10 @@ class ElasticRuntime:
     def _lease_inc(self, wid: str) -> Optional[str]:
         lease = self.membership.lease(wid)
         return None if lease is None else str(lease.get("inc", ""))
+
+    def _lease_rack(self, wid: str) -> str:
+        lease = self.membership.lease(wid)
+        return "" if lease is None else str(lease.get("rack", ""))
 
     def member_alive(self, wid: str) -> bool:
         """Alive AS THE MEMBER the adopted view admitted: fresh lease AND
@@ -464,12 +592,16 @@ class ElasticRuntime:
         rejoined = tuple(m for m in added
                          if self.store.exists(self._seen_key(m)))
         incs = {m: (self._lease_inc(m) or "") for m in members}
-        prev_incs = (dict(self.view.incs) if self.view is not None
-                     and tuple(sorted(prev)) == self.view.members else {})
+        racks = {m: self._lease_rack(m) for m in members}
+        carry = (self.view is not None
+                 and tuple(sorted(prev)) == self.view.members)
+        prev_incs = dict(self.view.incs) if carry else {}
+        prev_racks = dict(self.view.racks) if carry else {}
         cand = View(gen=gen, members=tuple(sorted(members)),
                     prev_members=tuple(sorted(prev)), epoch=sync[0],
                     step=sync[1], iteration=sync[2], reason=reason,
-                    rejoined=rejoined, incs=incs, prev_incs=prev_incs)
+                    rejoined=rejoined, incs=incs, prev_incs=prev_incs,
+                    racks=racks, prev_racks=prev_racks)
         if self.store.set_json_exclusive(_view_key(gen), cand.to_json()):
             return cand
         d = self.store.get_json(_view_key(gen))
@@ -521,6 +653,7 @@ class ElasticRuntime:
         timeout = knobs["boot_timeout_s"] if timeout is None else timeout
         self.membership.join()
         deadline = time.monotonic() + timeout
+        token = self.store.watch("", None)
         while True:
             latest = self.latest_view()
             if (latest is not None and self.wid in latest.members
@@ -552,7 +685,10 @@ class ElasticRuntime:
                     f"elastic bootstrap: worker {self.wid!r} saw "
                     f"{len(live)}/{world} live workers and no adoptable "
                     f"view within {timeout:.0f}s")
-            time.sleep(self.poll)
+            # wake on any store change (lease writes, view creates) or after
+            # one poll interval — lease EXPIRY makes no store event, so the
+            # timeout bound is what notices silent deaths
+            token = self.store.watch("", token, timeout=self.poll)
 
     # -- steady-state polling -----------------------------------------------
     def newer_view(self) -> Optional[View]:
@@ -603,6 +739,7 @@ class ElasticRuntime:
         :class:`MembershipChanged` (or times out)."""
         view = self.view
         deadline = time.monotonic() + self.wait_timeout
+        token = self.store.watch("", None)
         while True:
             self.check_for_change()
             live = self.membership.live()
@@ -619,7 +756,7 @@ class ElasticRuntime:
                     f"elastic shrink: no coordinator produced a view "
                     f"excluding {list(wids)} within "
                     f"{self.wait_timeout:.0f}s")
-            time.sleep(self.poll)
+            token = self.store.watch("view", token, timeout=self.poll)
 
     def await_readmission(self, should_stop=None) -> Optional[View]:
         """Expelled-worker path (partition healed past the TTL): renew the
@@ -631,6 +768,7 @@ class ElasticRuntime:
         obs.event("elastic_rejoin_wait", wid=self.wid,
                   gen=self.view.gen if self.view else -1)
         deadline = time.monotonic() + self.wait_timeout
+        token = self.store.watch("view", None)
         while True:
             latest = self.latest_view()
             if (latest is not None and self.wid in latest.members
@@ -645,7 +783,10 @@ class ElasticRuntime:
                 raise TimeoutError(
                     f"elastic rejoin: worker {self.wid!r} was not "
                     f"re-admitted within {self.wait_timeout:.0f}s")
-            time.sleep(self.poll)
+            # new views are store writes, so the watch wakes promptly; the
+            # timeout keeps should_stop responsive
+            token = self.store.watch("view", token,
+                                     timeout=max(self.poll, 0.05))
 
     # -- teardown -----------------------------------------------------------
     def leave(self) -> None:

@@ -43,7 +43,66 @@ from deeplearning4j_tpu.parallel.context import use_mesh
 from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
 from deeplearning4j_tpu.parallel.tp import tp_param_shardings
 
-__all__ = ["MeshTrainer", "shard_update_spec"]
+__all__ = ["MeshSlice", "MeshTrainer", "shard_update_spec"]
+
+
+class MeshSlice:
+    """One elastic member's device mesh in the elastic-of-slices
+    composition (``train/elastic.py``): the member process IS a whole
+    ``(d, t, s)`` slice, membership events happen per slice, and the
+    member's local compute (the vshard backward pass) runs GSPMD-sharded
+    over the slice's devices — batch over ``data``, params/state
+    replicated, XLA inserting the in-slice collectives. The fleet-level
+    exchange above stays explicit store payloads; preempting the slice
+    kills this one process.
+
+    ``spec`` is ``"d[,t[,s]]"`` (e.g. ``"2"``, ``"2,1,1"``). Bit-exactness
+    of elastic runs holds across member COUNT at a fixed slice shape — the
+    in-slice reduction order is the mesh's, so reference and chaos runs
+    must use the same spec.
+    """
+
+    def __init__(self, spec, devices=None):
+        d, t, s = self.parse_spec(spec)
+        self.spec = MeshSpec(data=d, model=t, pipe=s)
+        self.mesh = make_mesh(self.spec, list(devices)
+                              if devices is not None else jax.devices())
+        self.data = int(self.mesh.shape["data"])
+
+    @staticmethod
+    def parse_spec(spec) -> Tuple[int, int, int]:
+        if isinstance(spec, (tuple, list)):
+            parts = [int(v) for v in spec]
+        else:
+            parts = [int(v) for v in str(spec).split(",") if v.strip()]
+        if not parts or len(parts) > 3 or any(v < 1 for v in parts):
+            raise ValueError(
+                f"slice spec {spec!r}: want 1-3 positive ints 'd[,t[,s]]'")
+        return tuple(parts + [1] * (3 - len(parts)))  # type: ignore
+
+    def round_rows(self, rows: int) -> int:
+        """Smallest multiple of the data-axis size >= ``rows`` (vshard
+        micro-batches must divide evenly over the batch sharding)."""
+        return -(-int(rows) // self.data) * self.data
+
+    def shard_batch(self, arr):
+        """Place a leading-batch-dim array sharded over ``data``."""
+        if arr is None:
+            return None
+        spec = P("data", *([None] * (np.ndim(arr) - 1)))
+        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+
+    def replicate(self, tree):
+        """Place a pytree fully replicated on the slice."""
+        repl = NamedSharding(self.mesh, P())
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, repl), tree)
+
+    def run(self, fn, *args, **kwargs):
+        """Call ``fn`` under this slice's mesh context (GSPMD partitions
+        the jitted computation by the inputs' shardings)."""
+        with use_mesh(self.mesh):
+            return fn(*args, **kwargs)
 
 
 def shard_update_spec(spec: P, shape: Tuple[int, ...], mesh: Mesh,

@@ -49,6 +49,7 @@ import math
 import os
 import subprocess
 import sys
+import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -67,11 +68,35 @@ from deeplearning4j_tpu.parallel.elastic import (
     View,
 )
 from deeplearning4j_tpu.parallel.grads import _flat, _unflat
+from deeplearning4j_tpu.parallel.netstore import open_store
 from deeplearning4j_tpu.train import resilience
 from deeplearning4j_tpu.train.updaters import apply_gradient_normalization
 from deeplearning4j_tpu.utils import bucketing
 
-__all__ = ["ElasticTrainer"]
+__all__ = ["ElasticTrainer", "mirror_ranks"]
+
+
+def mirror_ranks(t: int, W: int, R: int,
+                 racks: Sequence[str] = ()) -> List[int]:
+    """Ranks holding mirrors of rank ``t``'s optimizer segments under
+    replication factor ``R`` (owner + R-1 mirrors, capped at the world
+    size) with rack-aware placement: candidates in OTHER racks than the
+    owner's sort first, ties broken by ring distance ``(t - r) % W`` —
+    nearest predecessor first. With uniform racks and R=2 this is exactly
+    the classic buddy (the mirror of ``t`` sits at ``(t-1) % W``, i.e.
+    worker ``r`` mirrors rank ``(r+1) % W``), which keeps the R=2 layout —
+    and with it every existing checkpoint shard and bit-exactness gate —
+    unchanged. Deterministic in its inputs, so every member derives the
+    same placement from the view's recorded rack labels."""
+    W = int(W)
+    R = min(int(R), W)
+    if R <= 1 or W <= 1:
+        return []
+    owner_rack = racks[t] if t < len(racks) else ""
+    return sorted(
+        (r for r in range(W) if r != t),
+        key=lambda r: ((racks[r] if r < len(racks) else "") == owner_rack,
+                       (t - r) % W))[:R - 1]
 
 
 # ---------------------------------------------------------------------------
@@ -120,13 +145,68 @@ class _JobDone(Exception):
     state was adopted from the ``done`` blob rank 0 leaves in the store."""
 
 
+class _Prefetcher:
+    """Asynchronous DCN payload fetch: polls the store for a set of keys
+    from a daemon thread so the fetch overlaps with in-process compute (my
+    own vshard backward passes, the dense update) instead of serializing
+    behind it at the boundary wait. ``drain()`` hands finished payloads to
+    the consumer; the boundary wait only blocks on whatever the overlap
+    didn't already cover — that residue is the measured
+    ``dl4j_elastic_boundary_stall_seconds``. Purely an ordering
+    optimization: payload bytes and the fixed-order combine are untouched,
+    so bit-exactness is unaffected (``DL4J_TPU_ELASTIC_ASYNC=0`` falls back
+    to the synchronous fetch)."""
+
+    def __init__(self, store, keys: Dict[Any, str], poll: float):
+        self.store = store
+        self._pending = dict(keys)
+        self.poll = float(poll)
+        self._lock = threading.Lock()
+        self._got: Dict[Any, Dict[str, np.ndarray]] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="elastic-prefetch", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while self._pending and not self._stop.is_set():
+            for ident, key in list(self._pending.items()):
+                if self._stop.is_set():
+                    return
+                try:
+                    data = self.store.get(key)
+                    arrays = (None if data is None
+                              else _unpack_arrays(data))
+                except (OSError, ValueError):
+                    return  # store gone / garbage: the sync path takes over
+                if arrays is not None:
+                    with self._lock:
+                        self._got[ident] = arrays
+                    del self._pending[ident]
+            if self._pending:
+                self._stop.wait(self.poll)
+
+    def drain(self) -> Dict[Any, Dict[str, np.ndarray]]:
+        with self._lock:
+            got = dict(self._got)
+            self._got.clear()
+        return got
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+
 class ElasticTrainer:
     """Synchronous elastic data-parallel trainer for a MultiLayerNetwork."""
 
     def __init__(self, model, store_dir, worker_id: str, *, world: int = 2,
                  vshards: Optional[int] = None, compress: bool = False,
                  threshold: float = 1e-3, ckpt_dir=None, ckpt_every: int = 0,
-                 ttl: Optional[float] = None, poll: Optional[float] = None):
+                 ttl: Optional[float] = None, poll: Optional[float] = None,
+                 replication: Optional[int] = None,
+                 rack: Optional[str] = None, slice_spec=None,
+                 async_exchange: Optional[bool] = None):
         from deeplearning4j_tpu.nn.graph import ComputationGraph
 
         if isinstance(model, ComputationGraph):
@@ -136,7 +216,7 @@ class ElasticTrainer:
         if model.params is None:
             model.init()
         self.model = model
-        self.store = FileStore(store_dir)
+        self.store = open_store(store_dir)
         self.wid = str(worker_id)
         self.world = int(world)
         self.vshards = None if vshards is None else int(vshards)
@@ -144,7 +224,25 @@ class ElasticTrainer:
         self.threshold = float(threshold)
         self.ckpt_dir = None if ckpt_dir is None else os.fspath(ckpt_dir)
         self.ckpt_every = int(ckpt_every)
-        self.rt = ElasticRuntime(self.store, self.wid, ttl=ttl, poll=poll)
+        self.replication = max(1, int(
+            os.environ.get("DL4J_TPU_ELASTIC_MIRRORS", "2")
+            if replication in (None, 0) else replication))
+        self.async_exchange = bool(
+            os.environ.get("DL4J_TPU_ELASTIC_ASYNC", "1") != "0"
+            if async_exchange is None else async_exchange)
+        if slice_spec:
+            from deeplearning4j_tpu.parallel.mesh_step import MeshSlice
+
+            self.slice: Optional[Any] = MeshSlice(slice_spec)
+        else:
+            self.slice = None
+        self.rt = ElasticRuntime(self.store, self.wid, ttl=ttl, poll=poll,
+                                 rack=rack)
+        obs.gauge("dl4j_mirror_replication_factor",
+                  "Configured optimizer-segment replication factor R "
+                  "(owner + R-1 mirrors, capped at world size)").set(
+                      self.replication)
+        self.stall_s = 0.0   # cumulative boundary time blocked on payloads
         self._build_plan()
         _, self._bwd, _ = model._get_phase_fns()
         self._base_rng = model._rng
@@ -231,9 +329,20 @@ class ElasticTrainer:
             subtrees.append(_unflat(jnp.asarray(row[:e.n]), e))
         return jax.tree_util.tree_unflatten(tdef, subtrees)
 
-    # -- vshard geometry -----------------------------------------------------
-    def _owned_ranks(self, rank: int, W: int) -> List[int]:
-        return [rank] if W == 1 else [rank, (rank + 1) % W]
+    # -- vshard / mirror geometry --------------------------------------------
+    def _view_racks(self, view: View, prev: bool = False) -> List[str]:
+        members = view.prev_members if prev else view.members
+        labels = view.prev_racks if prev else view.racks
+        return [labels.get(m, "") for m in members]
+
+    def _held_ranks(self, rank: int, W: int,
+                    racks: Sequence[str] = ()) -> List[int]:
+        """Segments this worker carries: its primary plus every rank whose
+        R-way rack-aware mirror set includes it (R=2, uniform racks ⇒ the
+        classic ``[rank, (rank+1) % W]`` buddy pair)."""
+        return [rank] + [t for t in range(W) if t != rank
+                         and rank in mirror_ranks(
+                             t, W, self.replication, racks)]
 
     def _vshard_owner(self, j: int) -> int:
         return j % self.rt.view.world
@@ -246,10 +355,11 @@ class ElasticTrainer:
     # -- forming / re-forming ------------------------------------------------
     def _slice_segs_from_full(self, full_by_key: Dict[int, np.ndarray],
                               view: View):
-        """(Re-)slice my primary + buddy-mirror segments for the new world
+        """(Re-)slice my primary + R-way mirror segments for the new world
         out of the full per-layer stat stacks."""
         W = view.world
         r = view.rank_of(self.wid)
+        held = self._held_ranks(r, W, self._view_racks(view))
         segs: Dict[int, Dict[int, np.ndarray]] = {}
         m_of: Dict[int, int] = {}
         for key in self._flat_keys:
@@ -261,7 +371,7 @@ class ElasticTrainer:
             padded = np.zeros((full.shape[0], n_pad), full.dtype)
             padded[:, :min(full.shape[1], n_pad)] = full[:, :n_pad]
             segs[key] = {t: padded[:, t * m:(t + 1) * m].copy()
-                         for t in self._owned_ranks(r, W)}
+                         for t in held}
         self._segs = segs
         self._m = m_of
 
@@ -477,9 +587,13 @@ class ElasticTrainer:
         self.step_in_epoch = int(view.step)
 
     def _hand_seg(self, hands, view: View, key: int, t: int):
+        """Rank ``t``'s outgoing segment from its old owner or ANY of its
+        old mirrors (R-way, in the previous view's geometry)."""
         prev = view.prev_members
-        for wid in (prev[t], prev[(t - 1) % len(prev)]):
-            a = hands.get(wid, {}).get(f"k{key}_t{t}")
+        sources = [t] + mirror_ranks(t, len(prev), self.replication,
+                                     self._view_racks(view, prev=True))
+        for s in sources:
+            a = hands.get(prev[s], {}).get(f"k{key}_t{t}")
             if a is not None:
                 return a
         return None
@@ -565,6 +679,9 @@ class ElasticTrainer:
         if chaos is None:
             return
         chaos.maybe_host_kill(it, rank=rank)
+        # elastic-of-slices: this member process IS its slice, so a slice
+        # preemption is one SIGKILL here (elastic rank == slice index)
+        chaos.maybe_slice_kill(it, slice_index=rank)
         secs = chaos.partition_seconds(it, rank=rank)
         if secs > 0:
             # the net_partition fault: stop heartbeating and stall — to the
@@ -578,6 +695,18 @@ class ElasticTrainer:
             self.rt.membership.heartbeat_now()
             obs.event("elastic_partition_end", wid=self.wid, rank=rank,
                       iteration=it)
+        rsecs = chaos.rack_partition_seconds(it, rack=self.rt.rack)
+        if rsecs > 0:
+            # rack_partition: same mechanics, rack-wide blast radius — every
+            # worker with the matching DL4J_TPU_RACK label goes dark at once
+            self.rt.membership.suspend(rsecs + self.rt.ttl)
+            obs.event("rack_partition", phase="begin", wid=self.wid,
+                      rack=self.rt.rack, rank=rank, iteration=it,
+                      seconds=rsecs)
+            time.sleep(rsecs)
+            self.rt.membership.heartbeat_now()
+            obs.event("rack_partition", phase="end", wid=self.wid,
+                      rack=self.rt.rack, rank=rank, iteration=it)
         chaos.maybe_preempt(it)
         chaos.maybe_slow(it)
 
@@ -599,13 +728,27 @@ class ElasticTrainer:
         x_j, y_j, fm, lm, ew = bucketing.pad_fit_batch(
             xb[lo:hi], yb[lo:hi], None, None, c, site="elastic.fit")
         rng_j = jax.random.fold_in(self._base_rng, it * v + j)
-        loss, new_state, grads = self._bwd(
-            model.params, model.state,
-            _cast_input(x_j, model.dtype), _cast_labels(y_j, model.dtype),
-            jnp.asarray(fm, model.dtype) if fm is not None else None,
-            jnp.asarray(lm, model.dtype) if lm is not None else None,
-            rng_j,
-            jnp.asarray(ew, model.dtype) if ew is not None else None)
+        x_c = _cast_input(x_j, model.dtype)
+        y_c = _cast_labels(y_j, model.dtype)
+        fm_c = jnp.asarray(fm, model.dtype) if fm is not None else None
+        lm_c = jnp.asarray(lm, model.dtype) if lm is not None else None
+        ew_c = jnp.asarray(ew, model.dtype) if ew is not None else None
+        if self.slice is not None:
+            # elastic-of-slices: the vshard's backward runs GSPMD-sharded
+            # over this member's (d,t,s) mesh — batch over the data axis,
+            # params/state replicated, XLA inserting the in-slice
+            # collectives (padded vshard rows are a multiple of d)
+            sl = self.slice
+            loss, new_state, grads = sl.run(
+                self._bwd, sl.replicate(model.params),
+                sl.replicate(model.state), sl.shard_batch(x_c),
+                sl.shard_batch(y_c), sl.shard_batch(fm_c),
+                sl.shard_batch(lm_c), sl.replicate(rng_j),
+                sl.shard_batch(ew_c))
+        else:
+            loss, new_state, grads = self._bwd(
+                model.params, model.state, x_c, y_c, fm_c, lm_c, rng_j,
+                ew_c)
         arrays: Dict[str, np.ndarray] = {
             "n": np.asarray(n_j, np.int64),
             "loss": np.float32(loss) * w,  # graftlint: disable=host-sync
@@ -633,8 +776,9 @@ class ElasticTrainer:
             arrays[f"s{li}"] = a
         return _pack_arrays(arrays)
 
-    def _await_vshards(self, g: int, it: int, view: View,
-                       sync) -> List[Dict[str, np.ndarray]]:
+    def _await_vshards(self, g: int, it: int, view: View, sync,
+                       prefetch: Optional[_Prefetcher] = None,
+                       ) -> List[Dict[str, np.ndarray]]:
         """Collect every vshard's payload for this step. A dead owner is
         unrecoverable mid-step (only it computed those gradients), so a
         lapsed lease drives a shrink and the survivors re-run the step."""
@@ -643,6 +787,11 @@ class ElasticTrainer:
         got: Dict[int, Dict[str, np.ndarray]] = {}
         deadline = time.monotonic() + self.rt.wait_timeout
         while want:
+            if prefetch is not None:
+                for j, arrays in prefetch.drain().items():
+                    if j in want:
+                        got[j] = arrays
+                        del want[j]
             for j, key in list(want.items()):
                 data = self.store.get(key)
                 if data is not None:
@@ -713,13 +862,14 @@ class ElasticTrainer:
 
     def _segment_update(self, gflat: np.ndarray, it: int, view: View):
         """Sharded optimizer update (arXiv 2004.13336): each worker updates
-        its primary 1/W segment AND its buddy's (the mirror). Elementwise
-        updater math means a segment's values are bitwise identical to the
-        same elements of a full-vector update. Returns
+        its primary 1/W segment AND every segment it mirrors (R-way).
+        Elementwise updater math means a segment's values are bitwise
+        identical to the same elements of a full-vector update. Returns
         ``(new_segs, pnew_segs, my_pseg_arrays)`` — committed only after
         the whole step succeeds."""
         W = view.world
         r = view.rank_of(self.wid)
+        held = self._held_ranks(r, W, self._view_racks(view))
         it_arr = jnp.asarray(it, jnp.int32)
         new_segs: Dict[int, Dict[int, np.ndarray]] = {}
         pnew: Dict[Tuple[int, int], np.ndarray] = {}
@@ -738,7 +888,7 @@ class ElasticTrainer:
             p_pad[:e.n] = p_full
             _, tdef = self._stat_template(e, m)
             new_segs[key] = {}
-            for t in self._owned_ranks(r, W):
+            for t in held:
                 sl = slice(t * m, (t + 1) * m)
                 g_seg = jnp.asarray(g_pad[sl]).astype(e.dtype)
                 p_seg = jnp.asarray(p_pad[sl])
@@ -791,17 +941,26 @@ class ElasticTrainer:
 
     def _await_psegs(self, g: int, it: int, view: View, sync,
                      my_pseg: Dict[str, np.ndarray],
-                     pnew: Dict[Tuple[int, int], np.ndarray]):
+                     pnew: Dict[Tuple[int, int], np.ndarray],
+                     prefetch: Optional[_Prefetcher] = None):
         """Collect every rank's updated param segment. A dead rank's segment
-        is recoverable: its buddy computed the identical update and serves
-        it (``dl4j_elastic_mirror_serves_total``); only a double failure
-        (owner AND buddy) forces the shrink-and-rerun path."""
+        is recoverable while ANY of its R-1 mirrors survives: the first
+        surviving mirror (in placement order — every worker derives the
+        same order) computed the identical update and serves it
+        (``dl4j_elastic_mirror_serves_total``); only the loss of the owner
+        AND all its mirrors forces the shrink-and-rerun path."""
         W = view.world
         r = view.rank_of(self.wid)
+        racks = self._view_racks(view)
         got: Dict[int, Dict[str, np.ndarray]] = {r: my_pseg}
         want = {t: f"pseg/{g}/{it}/{t}" for t in range(W) if t != r}
         deadline = time.monotonic() + self.rt.wait_timeout
         while want:
+            if prefetch is not None:
+                for t, arrays in prefetch.drain().items():
+                    if t in want:
+                        got[t] = arrays
+                        del want[t]
             for t, key in list(want.items()):
                 data = self.store.get(key)
                 if data is not None:
@@ -814,8 +973,12 @@ class ElasticTrainer:
             for t in list(want):
                 if self.rt.member_alive(view.members[t]):
                     continue
-                buddy = (t - 1) % W
-                if buddy == r:
+                mirrors = mirror_ranks(t, W, self.replication, racks)
+                alive = [s for s in mirrors if s == r
+                         or self.rt.member_alive(view.members[s])]
+                if not alive:
+                    unrecoverable.append(view.members[t])
+                elif alive[0] == r:
                     served = {f"k{key}": pnew[(key, t)]
                               for key in self._flat_keys}
                     self.store.set(f"pseg/{g}/{it}/{t}",
@@ -824,12 +987,12 @@ class ElasticTrainer:
                     del want[t]
                     obs.counter(
                         "dl4j_elastic_mirror_serves_total",
-                        "Dead ranks' param segments served from the buddy "
-                        "mirror").inc()
+                        "Dead ranks' param segments served from a "
+                        "surviving mirror").inc()
                     obs.event("elastic_mirror_serve", rank=t, by=self.wid,
                               iteration=it, gen=g)
-                elif not self.rt.member_alive(view.members[buddy]):
-                    unrecoverable.append(view.members[t])
+                # else: an earlier surviving mirror serves; keep waiting on
+                # the pseg key it will publish
             if unrecoverable:
                 self.rt.report_dead(sorted(set(unrecoverable)), sync)
             if time.monotonic() > deadline:
@@ -861,28 +1024,66 @@ class ElasticTrainer:
         it = int(self.model.iteration)
         sync = (self.epoch, self.step_in_epoch, it)
         r = view.rank_of(self.wid)
+        W = view.world
         self._chaos_hooks(it, r)
         self.rt.poll_boundary(sync)
         g = view.gen
-        with obs.span("elastic.step"):
-            for j in self._my_vshards():
-                self.store.set(f"grad/{g}/{it}/{j}",
-                               self._vshard_payload(j, xb, yb, it))
-            payloads = self._await_vshards(g, it, view, sync)
-            loss, gflat, dense_g, new_state = self._combine(payloads)
-            new_segs, pnew, my_pseg = self._segment_update(gflat, it, view)
-            self.store.set(f"pseg/{g}/{it}/{r}", _pack_arrays(my_pseg))
-            dense_params, dense_opt = self._dense_update(dense_g, it)
-            got = self._await_psegs(g, it, view, sync, my_pseg, pnew)
-            # commit: nothing above mutated trainer/model state, so a
-            # membership change mid-step leaves us at the exact boundary the
-            # re-formed group re-runs from
-            self._assemble_params(got, dense_params, view)
-            self._segs = new_segs
-            self._dense_opt.update(dense_opt)
-            self.model.state = new_state
-            self.model.iteration = it + 1
-            self.losses.append(float(loss))
+        mine = set(self._my_vshards())
+        fetchers: List[_Prefetcher] = []
+        stall0 = self.stall_s
+        try:
+            with obs.span("elastic.step"):
+                if self.async_exchange and len(mine) < self.vshards:
+                    # overlap fetching the peers' vshard payloads with
+                    # computing my own backward passes
+                    fetchers.append(_Prefetcher(
+                        self.store,
+                        {j: f"grad/{g}/{it}/{j}"
+                         for j in range(self.vshards) if j not in mine},
+                        self.rt.poll))
+                for j in self._my_vshards():
+                    self.store.set(f"grad/{g}/{it}/{j}",
+                                   self._vshard_payload(j, xb, yb, it))
+                t0 = time.monotonic()
+                payloads = self._await_vshards(
+                    g, it, view, sync,
+                    prefetch=fetchers[0] if fetchers else None)
+                self.stall_s += time.monotonic() - t0
+                loss, gflat, dense_g, new_state = self._combine(payloads)
+                new_segs, pnew, my_pseg = self._segment_update(
+                    gflat, it, view)
+                self.store.set(f"pseg/{g}/{it}/{r}", _pack_arrays(my_pseg))
+                pf = None
+                if self.async_exchange and W > 1:
+                    # overlap fetching the peers' param segments with the
+                    # dense (replicated) update below
+                    pf = _Prefetcher(
+                        self.store,
+                        {t: f"pseg/{g}/{it}/{t}"
+                         for t in range(W) if t != r},
+                        self.rt.poll)
+                    fetchers.append(pf)
+                dense_params, dense_opt = self._dense_update(dense_g, it)
+                t0 = time.monotonic()
+                got = self._await_psegs(g, it, view, sync, my_pseg, pnew,
+                                        prefetch=pf)
+                self.stall_s += time.monotonic() - t0
+                # commit: nothing above mutated trainer/model state, so a
+                # membership change mid-step leaves us at the exact boundary
+                # the re-formed group re-runs from
+                self._assemble_params(got, dense_params, view)
+                self._segs = new_segs
+                self._dense_opt.update(dense_opt)
+                self.model.state = new_state
+                self.model.iteration = it + 1
+                self.losses.append(float(loss))
+        finally:
+            for f in fetchers:
+                f.stop()
+        obs.histogram("dl4j_elastic_boundary_stall_seconds",
+                      "Per-step time blocked waiting on DCN payloads "
+                      "(vshards + param segments)").observe(
+                          self.stall_s - stall0)
         if r == 0 and it >= 2:
             self.store.prune(f"grad/{g}/{it - 2}")
             self.store.prune(f"pseg/{g}/{it - 2}")
@@ -1019,11 +1220,13 @@ class ElasticTrainer:
             elif e.mode == "dense":
                 new_opt.append(self._dense_opt[key])
             else:
+                racks = self._view_racks(view)
                 full = self._assemble_full_stats(
                     e, W,
                     lambda t, k=key: next(
                         (got[view.members[s]][f"k{k}_t{t}"]
-                         for s in (t, (t - 1) % W)
+                         for s in [t] + mirror_ranks(
+                             t, W, self.replication, racks)
                          if view.members[s] in got
                          and f"k{k}_t{t}" in got[view.members[s]]), None))
                 if full is None:
@@ -1100,7 +1303,7 @@ class ElasticTrainer:
         epochs = int(epochs)
         try:
             self._reform_initial(view)
-            self._vshard_rows = -(-bs // self.vshards)
+            self._vshard_rows = self._rows_per_vshard(bs)
             while self.epoch < epochs:
                 s = self.step_in_epoch
                 lo = s * bs
@@ -1109,7 +1312,7 @@ class ElasticTrainer:
                     self._run_step(xb, yb)
                 except MembershipChanged as mc:
                     self._reform(mc.view)
-                    self._vshard_rows = -(-bs // self.vshards)
+                    self._vshard_rows = self._rows_per_vshard(bs)
                     continue
                 self.step_in_epoch += 1
                 if self.step_in_epoch >= self._steps_per_epoch:
@@ -1136,7 +1339,18 @@ class ElasticTrainer:
             "losses": [float(v) for v in self.losses],
             "final_loss": (float(self.losses[-1]) if self.losses
                            else float("nan")),
+            "stall_s": float(self.stall_s),
+            "replication": int(self.replication),
+            "rack": self.rt.rack,
+            "store_backend": getattr(self.store, "backend", "file"),
+            "async_exchange": bool(self.async_exchange),
         }
+
+    def _rows_per_vshard(self, bs: int) -> int:
+        """Padded rows per vshard micro-batch; rounded up to the slice's
+        data-axis size so the in-slice batch sharding divides evenly."""
+        rows = -(-bs // self.vshards)
+        return self.slice.round_rows(rows) if self.slice else rows
 
     def _reform_initial(self, view: View):
         """Initial form after bootstrap — same machinery as any reform, via
@@ -1191,7 +1405,12 @@ def _cmd_worker(args) -> int:
         vshards=args.vshards, compress=args.compress,
         threshold=args.threshold,
         ckpt_dir=args.ckpt_dir, ckpt_every=args.ckpt_every,
-        ttl=args.ttl, poll=args.poll)
+        ttl=args.ttl, poll=args.poll,
+        replication=args.replication or None,
+        rack=args.rack if args.rack else None,
+        slice_spec=args.mesh or None,
+        async_exchange=None if args.async_exchange < 0
+        else bool(args.async_exchange))
     x, y = _make_data(args)
     try:
         result = trainer.fit(x, y, epochs=args.epochs,
@@ -1230,9 +1449,17 @@ def _cmd_launch(args) -> int:
     allowed_failures = int(args.allow_failures)
     failures: List[str] = []
 
+    racks = [r.strip() for r in args.racks.split(",")] if args.racks else []
+
     def spawn(wid: str, chaos: bool) -> subprocess.Popen:
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
+        if args.mesh and args.slice_devices:
+            # Must land in the child's env before jax imports: device count
+            # is fixed at backend init.
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                " --xla_force_host_platform_device_count="
+                                f"{args.slice_devices}").strip()
         if not chaos:
             env.pop("DL4J_TPU_CHAOS", None)
         cmd = [sys.executable, "-m", "deeplearning4j_tpu.train.elastic",
@@ -1244,6 +1471,15 @@ def _cmd_launch(args) -> int:
                "--lr", str(args.lr), "--seed", str(args.seed),
                "--ttl", str(args.ttl), "--poll", str(args.poll),
                "--threshold", str(args.threshold)]
+        if racks:
+            wi = int(wid[1:])
+            cmd += ["--rack", racks[wi % len(racks)]]
+        if args.replication:
+            cmd += ["--replication", str(args.replication)]
+        if args.mesh:
+            cmd += ["--mesh", args.mesh]
+        if args.async_exchange >= 0:
+            cmd += ["--async-exchange", str(args.async_exchange)]
         if args.vshards:
             cmd += ["--vshards", str(args.vshards)]
         if args.compress:
@@ -1302,7 +1538,9 @@ def _parser() -> argparse.ArgumentParser:
 
     def common(p):
         p.add_argument("--store", required=True,
-                       help="shared rendezvous/exchange directory")
+                       help="shared rendezvous/exchange store: a directory "
+                            "(or file:DIR) for FileStore, tcp://host:port "
+                            "for the network store")
         p.add_argument("--outdir", required=True)
         p.add_argument("--world", type=int, default=2)
         p.add_argument("--epochs", type=int, default=3)
@@ -1321,6 +1559,19 @@ def _parser() -> argparse.ArgumentParser:
                        default=0)
         p.add_argument("--ttl", type=float, default=2.0)
         p.add_argument("--poll", type=float, default=0.02)
+        p.add_argument("--rack", default="",
+                       help="failure-domain label for this worker "
+                            "(mirror placement avoids the owner's rack)")
+        p.add_argument("--replication", type=int, default=0,
+                       help="R-way mirror replication factor "
+                            "(0 = env/default)")
+        p.add_argument("--mesh", default="",
+                       help="per-member slice spec 'd[,t[,s]]' — run each "
+                            "member as a mesh_step slice of that shape")
+        p.add_argument("--async-exchange", dest="async_exchange",
+                       type=int, default=-1,
+                       help="1/0 force async DCN payload prefetch on/off "
+                            "(-1 = env/default)")
 
     w = sub.add_parser("worker", help="run one elastic worker")
     common(w)
@@ -1330,6 +1581,14 @@ def _parser() -> argparse.ArgumentParser:
     l = sub.add_parser("launch", help="supervise N local workers")
     common(l)
     l.add_argument("--workers", type=int, default=2)
+    l.add_argument("--racks", default="",
+                   help="comma-separated rack label per worker "
+                        "(w0,w1,... ; cycled if shorter than --workers)")
+    l.add_argument("--slice-devices", dest="slice_devices", type=int,
+                   default=0,
+                   help="virtual CPU device count per worker when --mesh "
+                        "is set (injects xla_force_host_platform_"
+                        "device_count)")
     l.add_argument("--relaunch", type=int, default=0,
                    help="relaunch budget for killed workers (rejoin path)")
     l.add_argument("--allow-failures", dest="allow_failures", type=int,

@@ -538,7 +538,8 @@ class _Fault:
 
 
 _FAULT_KINDS = ("preempt", "corrupt_ckpt", "nan_grad", "slow_iter",
-                "host_kill", "net_partition")
+                "host_kill", "net_partition", "slice_kill",
+                "rack_partition")
 
 
 def _parse_fault(token: str) -> _Fault:
@@ -629,6 +630,17 @@ class ChaosInjector:
       its lease heartbeat and stalls for ``seconds`` (default 5.0). A stall
       longer than the lease TTL gets the worker expelled; on waking it
       renews its lease and rejoins through the membership handoff.
+    - ``slice_kill@iter:K[:sliceN]`` — the fleet-scale flavor of kill: in
+      the elastic-of-slices composition each member process IS one
+      ``(d,t,s)`` mesh slice (member = slice coordinator), so a slice
+      preemption is one SIGKILL of the member whose slice index (= elastic
+      rank) matches ``sliceN`` (no target: every slice that consults the
+      hook). One membership event per slice, not per chip.
+    - ``rack_partition@iter:K[:LABEL][:seconds]`` — ``net_partition`` for a
+      whole rack: every worker whose ``DL4J_TPU_RACK`` label equals
+      ``LABEL`` (no label: all workers) suspends its heartbeat and stalls
+      for ``seconds`` (default 5.0) — the R-way rack-aware mirrors must
+      carry every optimizer segment whose owner sat in that rack.
 
     Faults are host-side and one-shot: a resumed run that re-executes the
     target iteration is NOT re-hit (the process that resumed carries a fresh
@@ -680,15 +692,22 @@ class ChaosInjector:
 
     # -- distributed hooks (ElasticTrainer step boundary) -------------------
     @staticmethod
-    def _rank_arg(arg: Optional[str]):
-        """Split a fault arg into (target_rank, rest): ``rank1:4.0`` ->
-        (1, "4.0"), ``rank2`` -> (2, None), ``3.5`` -> (None, "3.5")."""
+    def _prefixed_arg(arg: Optional[str], prefix: str):
+        """Split a fault arg into (target_index, rest) for a ``<prefix>N``
+        head: ``rank1:4.0`` -> (1, "4.0"), ``slice2`` -> (2, None), a
+        non-matching head -> (None, arg)."""
         if not arg:
             return None, None
         head, _, rest = arg.partition(":")
-        if head.startswith("rank") and head[4:].isdigit():
-            return int(head[4:]), (rest or None)
+        if head.startswith(prefix) and head[len(prefix):].isdigit():
+            return int(head[len(prefix):]), (rest or None)
         return None, arg
+
+    @staticmethod
+    def _rank_arg(arg: Optional[str]):
+        """Split a fault arg into (target_rank, rest): ``rank1:4.0`` ->
+        (1, "4.0"), ``rank2`` -> (2, None), ``3.5`` -> (None, "3.5")."""
+        return ChaosInjector._prefixed_arg(arg, "rank")
 
     def maybe_host_kill(self, iteration: int, *, rank: int) -> None:
         for f in self.faults:
@@ -702,6 +721,49 @@ class ChaosInjector:
             obs.event("chaos", fault="host_kill", iteration=iteration,
                       rank=rank)
             os.kill(os.getpid(), signal.SIGKILL)
+
+    def maybe_slice_kill(self, iteration: int, *, slice_index: int) -> None:
+        """SIGKILL this member process when a ``slice_kill`` fault targets
+        its slice index — one whole-slice preemption, one membership
+        event (the member process carries the entire slice mesh)."""
+        for f in self.faults:
+            if (f.kind != "slice_kill" or f.fired or f.at_iter is None
+                    or iteration < f.at_iter):
+                continue
+            target, _ = self._prefixed_arg(f.arg, "slice")
+            if target is not None and target != slice_index:
+                continue
+            f.fired = True
+            obs.event("slice_kill", iteration=iteration, slice=slice_index)
+            obs.event("chaos", fault="slice_kill", iteration=iteration,
+                      slice=slice_index)
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def rack_partition_seconds(self, iteration: int, *, rack: str) -> float:
+        """Non-zero when a ``rack_partition`` fault hits this worker's rack
+        label at this iteration; the caller owns the mechanics (suspend
+        heartbeat + stall), same as :meth:`partition_seconds`."""
+        for f in self.faults:
+            if (f.kind != "rack_partition" or f.fired or f.at_iter is None
+                    or iteration < f.at_iter):
+                continue
+            label: Optional[str] = None
+            secs = 5.0
+            if f.arg:
+                head, _, rest = f.arg.partition(":")
+                try:
+                    secs = float(head)   # bare seconds: every rack
+                except ValueError:
+                    label = head
+                    if rest:
+                        secs = float(rest)
+            if label is not None and label != rack:
+                continue
+            f.fired = True
+            obs.event("chaos", fault="rack_partition", iteration=iteration,
+                      rack=rack, seconds=secs)
+            return secs
+        return 0.0
 
     def partition_seconds(self, iteration: int, *, rank: int) -> float:
         """Non-zero when a ``net_partition`` fault targets this (iteration,

@@ -12,12 +12,14 @@ fallback; in-process unit tests cover the store CRC framing, lease expiry,
 the chaos grammar extensions, and checkpoint I/O retries.
 """
 
+import contextlib
 import json
 import os
 import subprocess
 import sys
 import threading
 import time
+import warnings
 
 import numpy as np
 import pytest
@@ -42,11 +44,15 @@ WORKLOAD = ["--epochs", "2", "--batch", "8", "--n", "24", "--features", "4",
 
 
 def _launch(root, name, *, workers, world, chaos=None, relaunch=0,
-            allow_failures=0, ckpt=None, ckpt_every=0, ttl=2.0, extra=()):
-    """Run the elastic CLI launcher to completion; returns the out dir."""
-    store = os.path.join(root, name, "store")
+            allow_failures=0, ckpt=None, ckpt_every=0, ttl=2.0, extra=(),
+            store=None):
+    """Run the elastic CLI launcher to completion; returns the out dir.
+    ``store`` overrides the per-scenario FileStore directory (e.g. a
+    ``tcp://host:port`` netstore spec)."""
+    if store is None:
+        store = os.path.join(root, name, "store")
+        os.makedirs(store, exist_ok=True)
     out = os.path.join(root, name, "out")
-    os.makedirs(store, exist_ok=True)
     os.makedirs(out, exist_ok=True)
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -188,6 +194,108 @@ def test_corrupt_distributed_shard_falls_back_to_mirror(baseline):
     assert dropped, "the corrupt shard should have been CRC-dropped"
 
 
+@contextlib.contextmanager
+def _net_server(root):
+    """A netstore server in its own process; yields its tcp:// spec."""
+    announce = os.path.join(root, "netstore.addr")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "deeplearning4j_tpu.parallel.netstore",
+         "serve", "--host", "127.0.0.1", "--port", "0",
+         "--data", os.path.join(root, "netstore.data"),
+         "--announce", announce],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.monotonic() + 20.0
+        while not os.path.exists(announce):
+            assert proc.poll() is None, "netstore server died at startup"
+            assert time.monotonic() < deadline, "server never announced"
+            time.sleep(0.05)
+        with open(announce) as f:
+            yield "tcp://" + f.read().strip()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_netstore_backend_end_to_end(baseline, tmp_path):
+    """DL4J_TPU_STORE parity at the system level: the trainers run
+    unmodified over the TCP store and land on the FileStore reference's
+    exact curve and params."""
+    with _net_server(str(tmp_path)) as spec:
+        out = _launch(baseline["root"], "netrun", workers=2, world=2,
+                      store=spec)
+    ref = _result(baseline["out1"])
+    got = _result(out, "w0")
+    assert got["store_backend"] == "tcp"
+    assert got["losses"] == ref["losses"]
+    _assert_params_equal(_params(out, "w0"), _params(baseline["out1"]),
+                         "netstore vs filestore params")
+
+
+@pytest.mark.slow
+def test_r3_survives_loss_of_two_mirrors(baseline):
+    """R=3 mirror replication: slice_kill takes out ranks 1 AND 2 at the
+    same boundary. Rank 0 holds a complete mirror set, rebuilds every
+    segment locally, and finishes on the uninterrupted curve."""
+    out = _launch(baseline["root"], "r3", workers=3, world=3,
+                  chaos="slice_kill@iter:3:slice1,slice_kill@iter:3:slice2",
+                  allow_failures=2, extra=("--replication", "3"))
+    ref = _result(baseline["out1"])
+    got = _result(out, "w0")
+    assert got["world"] == 1 and got["replication"] == 3
+    assert got["losses"] == ref["losses"]
+    _assert_params_equal(_params(out, "w0"), _params(baseline["out1"]),
+                         "post-double-kill params")
+
+
+@pytest.mark.slow
+def test_slice_members_bit_exact_across_member_count(baseline):
+    """Members are 2-device mesh slices (slice-level membership): killing a
+    whole slice shrinks the group, and the survivor matches a 1-slice run
+    of the SAME slice shape — bit-exactness is across member count at fixed
+    slice spec."""
+    extra = ("--mesh", "2", "--slice-devices", "2")
+    ref_out = _launch(baseline["root"], "slice_ref", workers=1, world=1,
+                      extra=extra)
+    out = _launch(baseline["root"], "slice_kill", workers=2, world=2,
+                  chaos="slice_kill@iter:3:slice1", allow_failures=1,
+                  extra=extra)
+    ref = _result(ref_out)
+    got = _result(out, "w0")
+    assert got["world"] == 1
+    assert got["losses"] == ref["losses"]
+    _assert_params_equal(_params(out, "w0"), _params(ref_out),
+                         "slice-kill survivor vs 1-slice reference")
+    events = [json.loads(l)
+              for l in open(os.path.join(out, "events_w1.jsonl"))]
+    assert any(e["kind"] == "slice_kill" for e in events), \
+        "the killed member should have logged the slice_kill fault"
+
+
+@pytest.mark.slow
+def test_rack_partition_shrinks_and_readmits_bit_exact(baseline):
+    """rack_partition suspends every member whose rack label matches: w1
+    (rackB) goes silent past the lease TTL, the group shrinks, the
+    partition heals, w1 is readmitted, and BOTH workers finish on the
+    uninterrupted curve."""
+    out = _launch(baseline["root"], "rackpart", workers=2, world=2,
+                  chaos="rack_partition@iter:3:rackB:1.0", ttl=1.0,
+                  extra=("--racks", "rackA,rackB"))
+    ref = _result(baseline["out1"])
+    for wid in ("w0", "w1"):
+        got = _result(out, wid)
+        assert got["world"] == 2, f"{wid} should end back at world 2"
+        assert got["losses"] == ref["losses"]
+        _assert_params_equal(_params(out, wid), _params(baseline["out1"]),
+                             f"post-rack-partition params ({wid})")
+    assert _result(out, "w1")["rack"] == "rackB"
+    events = [json.loads(l)
+              for l in open(os.path.join(out, "events_w1.jsonl"))]
+    phases = [e["phase"] for e in events if e["kind"] == "rack_partition"]
+    assert phases == ["begin", "end"], phases
+
+
 # ---------------------------------------------------------------------------
 # Membership runtime units (in-process)
 # ---------------------------------------------------------------------------
@@ -318,6 +426,108 @@ def test_chaos_host_kill_targets_rank_and_fires_once():
 def test_chaos_unknown_kind_still_rejected():
     with pytest.raises(ValueError, match="unknown kind"):
         ChaosInjector.parse("soft_kill@iter:3")
+
+
+def test_chaos_grammar_slice_kill_and_rack_partition():
+    inj = ChaosInjector.parse(
+        "slice_kill@iter:3:slice1,rack_partition@iter:2:rackA:1.5")
+    kinds = sorted(f.kind for f in inj.faults)
+    assert kinds == ["rack_partition", "slice_kill"]
+    sk = next(f for f in inj.faults if f.kind == "slice_kill")
+    assert sk.at_iter == 3 and sk.arg == "slice1"
+    # the generalized prefix splitter, and _rank_arg's exact legacy shape
+    assert ChaosInjector._prefixed_arg("slice2", "slice") == (2, None)
+    assert ChaosInjector._prefixed_arg("slice1:x", "slice") == (1, "x")
+    assert ChaosInjector._prefixed_arg("rank1:4.0", "rank") == (1, "4.0")
+    assert ChaosInjector._rank_arg("rank1:4.0") == (1, "4.0")
+    assert ChaosInjector._rank_arg("3.5") == (None, "3.5")
+    assert ChaosInjector._rank_arg(None) == (None, None)
+
+
+def test_chaos_slice_kill_targets_slice_index():
+    inj = ChaosInjector.parse("slice_kill@iter:3:slice1")
+    for it in range(10):
+        inj.maybe_slice_kill(it, slice_index=0)  # would SIGKILL if it fired
+
+
+def test_chaos_rack_partition_matches_label():
+    inj = ChaosInjector.parse("rack_partition@iter:2:rackB:0.75")
+    assert inj.rack_partition_seconds(1, rack="rackB") == 0.0
+    assert inj.rack_partition_seconds(2, rack="rackA") == 0.0, \
+        "a non-matching rack label must not fire (or consume) the fault"
+    assert inj.rack_partition_seconds(2, rack="rackB") == 0.75
+    assert inj.rack_partition_seconds(3, rack="rackB") == 0.0, "one-shot"
+    # bare seconds: every rack
+    inj2 = ChaosInjector.parse("rack_partition@iter:0:1.25")
+    assert inj2.rack_partition_seconds(0, rack="anything") == 1.25
+    # no arg: default duration, every rack
+    inj3 = ChaosInjector.parse("rack_partition@iter:0")
+    assert inj3.rack_partition_seconds(0, rack="r") == 5.0
+
+
+def test_mirror_ranks_rack_aware_placement():
+    from deeplearning4j_tpu.train.elastic import mirror_ranks
+    # R=2 with uniform racks IS the legacy buddy pair (checkpoint layout
+    # and membership-invariance gates depend on this exact orientation)
+    for W in range(2, 7):
+        for t in range(W):
+            assert mirror_ranks(t, W, 2, [""] * W) == [(t - 1) % W]
+    # two racks: the mirror always lands outside the owner's rack
+    racks = ["A", "A", "B", "B"]
+    for t in range(4):
+        (m,) = mirror_ranks(t, 4, 2, racks)
+        assert racks[m] != racks[t]
+    # R=3 over three racks: both mirrors land off-rack
+    racks = ["A", "B", "C", "A", "B", "C"]
+    for t in range(6):
+        ms = mirror_ranks(t, 6, 3, racks)
+        assert len(ms) == 2 and all(racks[m] != racks[t] for m in ms)
+    # degenerate shapes: R caps at W, and a single member has no mirrors
+    assert mirror_ranks(0, 2, 5, ["", ""]) == [1]
+    assert mirror_ranks(0, 1, 3, [""]) == []
+    assert mirror_ranks(2, 4, 1, [""] * 4) == []
+
+
+def test_set_exclusive_o_excl_fallback(tmp_path, monkeypatch):
+    """Filesystems without hardlinks (FAT, some NFS): set_exclusive falls
+    back to an O_EXCL create — exclusivity preserved, one RuntimeWarning
+    total, record still CRC-framed and readable."""
+    import deeplearning4j_tpu.parallel.elastic as pe
+
+    def no_link(src, dst):
+        raise OSError(38, "Function not implemented")
+
+    monkeypatch.setattr(os, "link", no_link)
+    monkeypatch.setattr(pe, "_LINK_FALLBACK_WARNED", False)
+    store = FileStore(tmp_path)
+    with pytest.warns(RuntimeWarning, match="os.link unsupported"):
+        assert store.set_exclusive("view/00000001", b"winner")
+    assert not store.set_exclusive("view/00000001", b"loser")
+    assert store.get("view/00000001") == b"winner"
+    # warn-once: further fallbacks stay quiet
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert store.set_exclusive("view/00000002", b"x")
+
+
+def test_membership_suspend_blocks_renewal(tmp_path):
+    """suspend() and the heartbeat thread share a lock: no renewal may land
+    during the suspension window, and heartbeat_now() lifts it."""
+    store = FileStore(tmp_path)
+    m = Membership(store, "w", ttl=0.4, poll=0.02)
+    m.join()
+    try:
+        m.suspend(30.0)
+        ts0 = m.lease("w")["ts"]
+        time.sleep(0.6)
+        lease = m.lease("w")
+        assert lease["ts"] == ts0, "heartbeat renewed a suspended lease"
+        assert not m._fresh(lease)
+        m.heartbeat_now()
+        assert m._fresh(m.lease("w"))
+    finally:
+        m.leave()
+    assert m._thread is None, "leave() must reap the heartbeat thread"
 
 
 def test_io_with_retries_backoff_and_counter(monkeypatch):

