@@ -628,14 +628,21 @@ def _lock_hot_sync_findings(index: Index) -> List[Finding]:
 # ring per call — report-time surfaces only
 _TRACE_EXPORT_CALLS = {"live_trace", "trace_events"}
 
+# fleet federation entry points (obs/fleet.py): each serializes the whole
+# metrics registry + span summary and does store I/O (or scans every
+# worker's snapshot) — report-time/boundary surfaces only, never per batch
+_FLEET_CALLS = {"publish_snapshot", "collect_snapshots", "serve_collector"}
+
 
 def _rule_cost_analysis_off_hot_path(index: Index) -> List[Finding]:
     """``cost_analysis()``/``memory_analysis()`` walk the lowered/compiled
-    HLO modules host-side — milliseconds per call — and the trace-export
-    helpers serialize the whole span ring. Neither belongs in traced bodies
-    (baked in at trace time, re-run per compile) or per-batch dispatch code
-    (latency per step). Harvest at compile time and render at report time
-    instead (obs/profile.py, obs/trace_export.py)."""
+    HLO modules host-side — milliseconds per call — the trace-export
+    helpers serialize the whole span ring, and the fleet federation
+    helpers (obs/fleet.py) additionally do store I/O. None belongs in
+    traced bodies (baked in at trace time, re-run per compile) or
+    per-batch dispatch code (latency per step). Harvest at compile time
+    and render at report time instead (obs/profile.py, obs/trace_export.py,
+    obs/fleet.py)."""
     out = []
     for q in sorted(index.traced | index.hot):
         fi = index.functions[q]
@@ -665,6 +672,13 @@ def _rule_cost_analysis_off_hot_path(index: Index) -> List[Finding]:
                         "code: serializes the span ring per call; export at "
                         "report time (/debug/trace, DL4J_TPU_SPAN_DUMP) "
                         "instead")
+                elif leaf in _FLEET_CALLS:
+                    f = index.make_finding(
+                        "cost-analysis-off-hot-path", fi, node.lineno,
+                        f"fleet federation ({leaf}) reachable from {where} "
+                        "code: serializes the metrics registry and does "
+                        "store I/O per call; publish at step boundaries / "
+                        "collect at report time (obs/fleet.py) instead")
             if f:
                 out.append(f)
     return out

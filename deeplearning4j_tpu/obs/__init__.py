@@ -40,11 +40,16 @@ __all__ = [
     "configure_event_log",
     "cost_report",
     "counter",
+    "current_trace",
     "enabled",
     "event",
     "event_log",
     "gauge",
     "histogram",
+    "process_context",
+    "publish_snapshot",
+    "set_process_context",
+    "trace_scope",
     "observe_itl",
     "observe_request",
     "observe_shed",
@@ -181,6 +186,47 @@ def set_decode_occupancy(model: str, streams: int):
     from deeplearning4j_tpu.obs import slo as _slo
 
     _slo.set_decode_occupancy(model, streams)
+
+
+# -- fleet (cross-process: trace context, federation) -----------------------
+
+def current_trace():
+    """The thread's active W3C trace context, or None (see obs/fleet.py)."""
+    from deeplearning4j_tpu.obs import fleet as _fleet
+
+    return _fleet.current_trace()
+
+
+def trace_scope(ctx):
+    """``with obs.trace_scope(ctx): ...`` — spans/events recorded inside
+    carry ``ctx``'s trace/span ids (see obs/fleet.py)."""
+    from deeplearning4j_tpu.obs import fleet as _fleet
+
+    return _fleet.trace_scope(ctx)
+
+
+def set_process_context(**fields):
+    """Tag this process's spans/events with rank/wid/incarnation/slice
+    (see obs/fleet.py)."""
+    from deeplearning4j_tpu.obs import fleet as _fleet
+
+    _fleet.set_process_context(**fields)
+
+
+def process_context() -> dict:
+    """host/pid plus any identity set via ``set_process_context``."""
+    from deeplearning4j_tpu.obs import fleet as _fleet
+
+    return _fleet.process_context()
+
+
+def publish_snapshot(store, wid: str, extra: Optional[dict] = None) -> str:
+    """Publish this process's metrics into the elastic store for the fleet
+    collector (see obs/fleet.py). Report-time only — never call from
+    traced/per-batch code."""
+    from deeplearning4j_tpu.obs import fleet as _fleet
+
+    return _fleet.publish_snapshot(store, wid, extra=extra)
 
 
 # -- events -----------------------------------------------------------------

@@ -2,10 +2,16 @@
 
 One line per event::
 
-    {"ts": 1754400000.123, "kind": "checkpoint_saved", "path": "...", ...}
+    {"ts": 1754400000.123, "kind": "checkpoint_saved", "host": "...",
+     "pid": 1234, "perf_s": 12.345, "path": "...", ...}
 
 ``ts`` is intentionally wall-clock (log lines are correlated with external
 systems); all DURATION fields are computed by callers from monotonic clocks.
+Every line is also stamped by ``obs/fleet.py`` with ``host``/``pid``/
+``perf_s`` — the (ts, perf_s) pair on each line is a wall↔perf anchor, so
+merging logs from many hosts never relies on synchronized wall clocks —
+plus ``rank``/``inc`` when an elastic process context is set and
+``trace_id`` when emitted inside a request's trace scope.
 Telemetry must never take training down — same discipline as
 ``ui/storage.py``'s remote router: serialization falls back to ``str()``,
 any I/O error drops the event (counted in ``dl4j_events_dropped_total``)
@@ -30,7 +36,7 @@ import threading
 import time
 from typing import Optional
 
-from deeplearning4j_tpu.obs import metrics
+from deeplearning4j_tpu.obs import fleet, metrics
 
 __all__ = ["EventLog", "event_log"]
 
@@ -105,6 +111,7 @@ class EventLog:
                     return
                 rec = {"ts": time.time(), "kind": kind}  # graftlint: disable=jit-purity
                 rec.update(fields)
+                fleet.stamp_event(rec)
                 try:
                     line = json.dumps(rec, default=str)
                 except (TypeError, ValueError):

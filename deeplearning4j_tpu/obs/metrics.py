@@ -13,6 +13,12 @@ already follows):
 - **Bounded memory.** Histograms keep (count, sum, min, max) exactly and a
   bounded reservoir of recent observations for quantiles; series counts are
   bounded by the code's own label cardinality (sites, buckets, event kinds).
+- **Mergeable across processes.** Every histogram series also maintains
+  fixed log-spaced bucket counts (``BUCKET_BOUNDS``, identical in every
+  process by construction). Quantiles of per-process quantiles are wrong;
+  bucket counts ADD, so the fleet collector (obs/fleet.py) merges worker
+  snapshots by summing counts and re-derives federated quantiles with
+  :func:`quantile_from_buckets`.
 
 The registry is process-global (``registry()``); ``bucketing.telemetry()``
 is an adapter shim over families registered here (utils/bucketing.py), so
@@ -22,18 +28,57 @@ every counter that existed before this layer is scrapeable at /metrics.
 from __future__ import annotations
 
 import threading
+from bisect import bisect_left
 from collections import deque
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = [
+    "BUCKET_BOUNDS",
     "Counter",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "quantile_from_buckets",
     "registry",
 ]
 
 _RESERVOIR = 256  # recent observations kept per histogram series (debug view)
+
+
+def _default_bounds() -> Tuple[float, ...]:
+    # 1/2.5/5 ladder per decade from 1µ to 500k: covers latencies (µs..hours),
+    # batch rows, and byte-ish magnitudes with one shared, process-invariant
+    # ladder — identical bounds everywhere is what makes counts mergeable.
+    out: List[float] = []
+    for exp in range(-6, 6):
+        for m in (1.0, 2.5, 5.0):
+            out.append(m * 10.0 ** exp)
+    return tuple(out)
+
+
+BUCKET_BOUNDS: Tuple[float, ...] = _default_bounds()
+
+
+def quantile_from_buckets(counts: Sequence[float], q: float,
+                          bounds: Sequence[float] = BUCKET_BOUNDS) -> float:
+    """Quantile estimate from (possibly merged) per-bucket counts.
+    ``counts`` is non-cumulative with ``len(bounds) + 1`` entries (the last
+    is the overflow bucket); linear interpolation inside the landing
+    bucket."""
+    total = float(sum(counts))
+    if total <= 0:
+        return 0.0
+    target = min(max(q, 0.0), 1.0) * total
+    acc = 0.0
+    for i, c in enumerate(counts):
+        acc += c
+        if acc >= target and c > 0:
+            if i >= len(bounds):
+                return float(bounds[-1])  # overflow bucket: clamp
+            lo = bounds[i - 1] if i > 0 else 0.0
+            frac = (target - (acc - c)) / c
+            return lo + (float(bounds[i]) - lo) * frac
+    return float(bounds[-1])
 
 # Quantiles tracked per histogram series via P² estimators (streaming, O(1)
 # memory per quantile — serving SLOs need p95/p99 that stay correct over
@@ -188,7 +233,8 @@ class Gauge(_Family):
 
 
 class _HistSeries:
-    __slots__ = ("count", "total", "min", "max", "reservoir", "quantiles")
+    __slots__ = ("count", "total", "min", "max", "reservoir", "quantiles",
+                 "buckets")
 
     def __init__(self):
         self.count = 0
@@ -197,6 +243,9 @@ class _HistSeries:
         self.max = float("-inf")
         self.reservoir = deque(maxlen=_RESERVOIR)
         self.quantiles = tuple(_P2Quantile(p) for p in _QUANTILES)
+        # non-cumulative counts over BUCKET_BOUNDS (+1 overflow bucket):
+        # the mergeable export — counts add across processes
+        self.buckets = [0] * (len(BUCKET_BOUNDS) + 1)
 
 
 class Histogram(_Family):
@@ -221,6 +270,7 @@ class Histogram(_Family):
             if v > s.max:
                 s.max = v
             s.reservoir.append(v)
+            s.buckets[bisect_left(BUCKET_BOUNDS, v)] += 1
             for est in s.quantiles:
                 est.add(v)
 
@@ -242,6 +292,7 @@ class Histogram(_Family):
         }
         for est in s.quantiles:
             out[f"p{int(est.p * 100)}"] = est.value()
+        out["buckets"] = list(s.buckets)
         return out
 
     def as_dict(self) -> Dict[Tuple[str, ...], dict]:
@@ -305,6 +356,30 @@ class MetricsRegistry:
                 elif isinstance(fam, (Counter, Gauge)):
                     series[skey] = fam.value(**labels)
             out[fam.name] = series
+        return out
+
+    def export(self) -> dict:
+        """Typed dump for cross-process federation (obs/fleet.py): unlike
+        ``snapshot()`` this keeps each family's kind/help/label names, so a
+        collector that never imported the producing code can re-render a
+        correct exposition. Histogram series carry the mergeable bucket
+        counts (``BUCKET_BOUNDS`` ladder)."""
+        out: Dict[str, dict] = {}
+        for fam in self.families():
+            series: Dict[str, object] = {}
+            for key, _ in fam.series():
+                labels = dict(zip(fam.label_names, key))
+                skey = "|".join(f"{k}={v}" for k, v in labels.items()) or ""
+                if isinstance(fam, Histogram):
+                    series[skey] = fam.summary(**labels)
+                elif isinstance(fam, (Counter, Gauge)):
+                    series[skey] = fam.value(**labels)
+            out[fam.name] = {
+                "kind": fam.kind,
+                "help": fam.help,
+                "labels": list(fam.label_names),
+                "series": series,
+            }
         return out
 
     def prometheus_text(self) -> str:

@@ -6,7 +6,10 @@ per route:
 
 - ``dl4j_request_seconds{route}``     — latency histogram whose P² streaming
   quantiles (p50/p95/p99, obs/metrics.py) stay accurate over the whole
-  stream, not just a recent window;
+  stream, not just a recent window. Each series also carries mergeable
+  fixed-boundary bucket counts (``metrics.BUCKET_BOUNDS``), so the fleet
+  collector (obs/fleet.py) can ADD counts across workers and compute a
+  true federated p99 — quantiles themselves never merge;
 - ``dl4j_requests_total{route,status}`` — request counter (``status`` is the
   HTTP status class or ``ok``/``error`` for non-HTTP paths);
 - ``dl4j_slo_burn_rate{route}``       — how fast the route is spending its

@@ -44,7 +44,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
-from deeplearning4j_tpu.obs import metrics
+from deeplearning4j_tpu.obs import fleet, metrics
 
 __all__ = ["SpanTracer", "compile_span", "tracer"]
 
@@ -178,6 +178,10 @@ class SpanTracer:
             rec["error"] = True
         if sp.attrs:
             rec["attrs"] = sp.attrs
+        # rank/incarnation + active trace ids (obs/fleet.py) — cheap dict
+        # writes; records keep the rank current when they were recorded,
+        # which matters across elastic reforms
+        fleet.stamp_span(rec)
         with self._lock:
             if len(self._ring) == self._ring.maxlen:
                 self._dropped.inc()
@@ -223,7 +227,8 @@ class SpanTracer:
         (``python -m deeplearning4j_tpu.obs.trace_export --spans <path>``).
         Returns the number of spans written."""
         spans = self.recent()
-        doc = {"anchor": self.anchor(), "spans": spans}
+        doc = {"anchor": self.anchor(), "spans": spans,
+               "process": fleet.process_context()}
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(doc, f)

@@ -38,9 +38,10 @@ import json
 import sys
 from typing import Dict, Iterable, List, Optional
 
-__all__ = ["trace_events", "render", "live_trace", "validate", "main"]
+__all__ = ["trace_events", "render", "live_trace", "merge", "validate",
+           "main"]
 
-_PID = 1  # single-process timeline; lanes are threads
+_PID = 1  # single-process timeline; lanes are threads (merge() re-pids)
 
 
 def trace_events(spans: Iterable[dict],
@@ -131,17 +132,67 @@ def live_trace(include_events: bool = False) -> str:
     return render(tr.recent(), evs, tr.anchor())
 
 
+def merge(dumps: Iterable[dict],
+          events_seq: Optional[List[List[dict]]] = None) -> dict:
+    """Join per-process span dumps (``SpanTracer.dump`` docs) into ONE
+    timeline: every process becomes its own Perfetto track (distinct
+    ``pid`` + ``process_name`` metadata naming its rank/wid/host), with
+    all tracks aligned on a common wall-clock axis through each dump's own
+    wall↔perf anchor — cross-host alignment never assumes the hosts agree
+    about *when*, only that each process sampled its anchor pair back to
+    back. ``events_seq`` optionally carries each dump's event-log records
+    (same order). Report-time only."""
+    merged: List[dict] = []
+    offsets: List[float] = []
+    for i, dump in enumerate(dumps):
+        anchor = dump.get("anchor") if isinstance(dump, dict) else None
+        spans = dump.get("spans", []) if isinstance(dump, dict) else dump
+        evs = (events_seq[i] if events_seq and i < len(events_seq) else ())
+        doc = trace_events(spans, evs, anchor)
+        proc = (dump.get("process") or {}) if isinstance(dump, dict) else {}
+        pid = i + 1
+        rank = proc.get("rank")
+        label = (f"rank {rank}" if rank is not None else f"proc {pid}")
+        if proc.get("wid"):
+            label += f" ({proc['wid']})"
+        if proc.get("host"):
+            label += f" @{proc['host']}"
+        # perf-axis µs -> wall-axis µs: shift by this dump's own anchor
+        off = 0.0
+        if isinstance(anchor, dict) and \
+                anchor.get("wall_s") is not None and \
+                anchor.get("perf_s") is not None:
+            off = (float(anchor["wall_s"]) - float(anchor["perf_s"])) * 1e6
+        for e in doc["traceEvents"]:
+            e["pid"] = pid
+            if "ts" in e:
+                e["ts"] += off
+                offsets.append(e["ts"])
+        merged.extend(doc["traceEvents"])
+        merged.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": label}})
+    if offsets:
+        # normalize so the merged timeline starts near zero (epoch-scale µs
+        # values render, but pan/zoom UX is much better from the origin)
+        t0 = min(offsets)
+        for e in merged:
+            if "ts" in e:
+                e["ts"] -= t0
+    merged.sort(key=lambda e: (e["ph"] == "M", e.get("ts", 0.0)))
+    return {"traceEvents": merged, "displayTimeUnit": "ms"}
+
+
 def validate(doc: dict) -> List[str]:
     """Schema + nesting sanity of a trace document. Returns problems (empty
     = loadable). Checks: top-level shape, required per-event fields, and
-    that complete events on each thread lane are properly nested (a child
-    slice must lie inside its enclosing slice — exactly what Perfetto
-    requires to stack them)."""
+    that complete events on each (process, thread) lane are properly nested
+    (a child slice must lie inside its enclosing slice — exactly what
+    Perfetto requires to stack them)."""
     problems: List[str] = []
     evs = doc.get("traceEvents")
     if not isinstance(evs, list):
         return ["traceEvents is not a list"]
-    lanes: Dict[int, List[dict]] = {}
+    lanes: Dict[tuple, List[dict]] = {}
     for i, e in enumerate(evs):
         if not isinstance(e, dict) or "ph" not in e or "name" not in e:
             problems.append(f"event {i}: missing ph/name")
@@ -151,7 +202,8 @@ def validate(doc: dict) -> List[str]:
                     not isinstance(e.get("dur"), (int, float)):
                 problems.append(f"event {i} ({e['name']}): bad ts/dur")
                 continue
-            lanes.setdefault(int(e.get("tid", 0)), []).append(e)
+            lanes.setdefault(
+                (int(e.get("pid", 0)), int(e.get("tid", 0))), []).append(e)
         elif e["ph"] == "i" and not isinstance(e.get("ts"), (int, float)):
             problems.append(f"event {i} ({e['name']}): instant without ts")
     eps = 1e-3  # µs slack for float rounding at the boundaries
@@ -165,7 +217,7 @@ def validate(doc: dict) -> List[str]:
                 parent = stack[-1]
                 if e["ts"] + e["dur"] > parent["ts"] + parent["dur"] + eps:
                     problems.append(
-                        f"tid {tid}: {e['name']} overlaps {parent['name']} "
+                        f"lane {tid}: {e['name']} overlaps {parent['name']} "
                         "without nesting")
             stack.append(e)
     return problems
@@ -193,12 +245,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="python -m deeplearning4j_tpu.obs.trace_export",
         description="Render a DL4J_TPU_SPAN_DUMP file (+ optional event log) "
                     "as Chrome/Perfetto trace_event JSON.")
-    ap.add_argument("--spans", required=True,
+    ap.add_argument("--spans", required=True, nargs="+",
                     help="span dump JSON written by DL4J_TPU_SPAN_DUMP or "
-                         "SpanTracer.dump()")
-    ap.add_argument("--events", default=None,
+                         "SpanTracer.dump(); several files merge into one "
+                         "multi-process timeline (one track per dump)")
+    ap.add_argument("--events", default=None, nargs="*",
                     help="optional DL4J_TPU_EVENT_LOG JSONL to overlay as "
-                         "instant events")
+                         "instant events (with several --spans, matched by "
+                         "position)")
     ap.add_argument("--out", default="-",
                     help="output path (default stdout)")
     ap.add_argument("--validate", action="store_true",
@@ -206,12 +260,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "on problems")
     args = ap.parse_args(argv)
 
-    with open(args.spans, "r", encoding="utf-8") as f:
-        dump = json.load(f)
-    spans = dump.get("spans", dump if isinstance(dump, list) else [])
-    anchor = dump.get("anchor") if isinstance(dump, dict) else None
-    events = _read_events(args.events) if args.events else []
-    doc = trace_events(spans, events, anchor)
+    dumps = []
+    for path in args.spans:
+        with open(path, "r", encoding="utf-8") as f:
+            dumps.append(json.load(f))
+    ev_paths = args.events or []
+    if len(dumps) == 1:
+        dump = dumps[0]
+        spans = dump.get("spans", dump if isinstance(dump, list) else [])
+        anchor = dump.get("anchor") if isinstance(dump, dict) else None
+        events = _read_events(ev_paths[0]) if ev_paths else []
+        doc = trace_events(spans, events, anchor)
+    else:
+        events_seq = [_read_events(p) for p in ev_paths] or None
+        doc = merge(dumps, events_seq)
     text = json.dumps(doc)
     if args.out == "-":
         sys.stdout.write(text + "\n")

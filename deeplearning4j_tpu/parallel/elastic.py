@@ -633,6 +633,11 @@ class ElasticRuntime:
             # membership history marker: a future re-admission of this wid
             # is a REJOIN, not a first join (counted separately above)
             self.store.set(self._seen_key(self.wid), b"1")
+            # fleet identity: every span/event this process records from
+            # here on carries its rank/incarnation, so the collector and
+            # the merged trace can tell the workers apart
+            obs.set_process_context(rank=rank, wid=self.wid,
+                                    incarnation=self.membership.incarnation)
         self.view = view
         return view
 

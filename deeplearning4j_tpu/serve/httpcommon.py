@@ -28,6 +28,7 @@ from typing import Optional, Tuple
 from urllib.parse import urlparse
 
 from deeplearning4j_tpu import obs
+from deeplearning4j_tpu.obs import fleet
 
 __all__ = ["InFlight", "ObservedHandler", "start_server"]
 
@@ -62,6 +63,11 @@ class ObservedHandler(BaseHTTPRequestHandler):
     """
 
     inflight: Optional[InFlight] = None
+    # the request's trace context (obs/fleet.py): adopted from an inbound
+    # W3C ``traceparent`` header (same trace, fresh span id) or minted at
+    # this front door; echoed on every reply and stamped onto every
+    # span/event recorded while the handler runs
+    trace: Optional[fleet.TraceContext] = None
 
     def log_message(self, *a):  # quiet: obs carries the signal
         pass
@@ -73,12 +79,17 @@ class ObservedHandler(BaseHTTPRequestHandler):
 
     def _observed(self, handler):
         route = self.slo_route(urlparse(self.path).path)
+        inbound = fleet.TraceContext.parse(self.headers.get("traceparent"))
+        self.trace = inbound.child() if inbound else fleet.TraceContext.mint()
         if self.inflight is not None:
             self.inflight.note(1)
         t0 = time.perf_counter()
         status = 500
         try:
-            status = handler()
+            with fleet.trace_scope(self.trace), \
+                    obs.span("http.request", route=route,
+                             method=self.command):
+                status = handler()
         finally:
             if self.inflight is not None:
                 self.inflight.note(-1)
@@ -119,6 +130,8 @@ class ObservedHandler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        if self.trace is not None:
+            self.send_header("traceparent", self.trace.header())
         for k, v in headers:
             self.send_header(k, v)
         self.end_headers()

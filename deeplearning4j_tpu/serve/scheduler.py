@@ -45,6 +45,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from deeplearning4j_tpu import obs
+from deeplearning4j_tpu.obs import fleet
 from deeplearning4j_tpu.serve.admission import (
     AdmissionController, GenerateConfig, LatencyModel, ServeConfig,
     TokenAdmission)
@@ -68,9 +69,21 @@ class ShedError(RuntimeError):
         return 429 if self.reason == "backpressure" else 503
 
 
+def _trace_attrs(batch) -> Dict[str, str]:
+    """Span attrs linking one coalesced dispatch back to the trace ids of
+    every request in it (deduped, submit order) — the join key between a
+    front-door ``http.request`` span and the batch that served it."""
+    ids: List[str] = []
+    for r in batch:
+        t = getattr(r, "trace", None)
+        if t is not None and t.trace_id not in ids:
+            ids.append(t.trace_id)
+    return {"traces": ",".join(ids)} if ids else {}
+
+
 class _Req:
     __slots__ = ("x", "rows", "deadline", "arrival", "event", "result",
-                 "error")
+                 "error", "trace")
 
     def __init__(self, x, deadline: float, arrival: float):
         self.x = x
@@ -80,6 +93,10 @@ class _Req:
         self.event = threading.Event()
         self.result = None
         self.error: Optional[Exception] = None
+        # the submitter's trace context: the dispatcher thread runs on its
+        # own stack, so the HTTP front door's traceparent must ride the
+        # request object to reach the dispatch span
+        self.trace: Optional[fleet.TraceContext] = None
 
 
 class ModelWorker:
@@ -138,6 +155,7 @@ class ModelWorker:
         if deadline_s is None:
             deadline_s = self.config.default_deadline_s
         r = _Req(x, now + deadline_s, now)
+        r.trace = fleet.current_trace()
         # arrival feasibility BEFORE touching the queue: a request whose
         # bucket measurably overruns its own deadline wastes queue space
         # and device time — reject it while it is cheapest (503 semantics)
@@ -248,14 +266,16 @@ class ModelWorker:
                   if bucketing.bucketing_enabled() else total)
         bucketing.telemetry().record_hit(self.route, total, bucket)
         try:
-            xs = (batch[0].x if len(batch) == 1
-                  else np.concatenate([r.x for r in batch], axis=0))
-            t0 = time.perf_counter()
-            # model.output pads up the shared ladder itself, so this
-            # dispatch hits the SAME executable (and AOT warm entry) a
-            # direct caller would — the basis of coalescing bit-exactness
-            out = np.asarray(self.model.output(xs))
-            dt = time.perf_counter() - t0
+            with obs.span("serve.dispatch", model=self.name,
+                          rows=int(total), **_trace_attrs(batch)):
+                xs = (batch[0].x if len(batch) == 1
+                      else np.concatenate([r.x for r in batch], axis=0))
+                t0 = time.perf_counter()
+                # model.output pads up the shared ladder itself, so this
+                # dispatch hits the SAME executable (and AOT warm entry) a
+                # direct caller would — the basis of coalescing bit-exactness
+                out = np.asarray(self.model.output(xs))
+                dt = time.perf_counter() - t0
             self.latency.observe(self.name, bucket, dt)
             self._batches.inc(model=self.name)
             self._batch_rows.observe(total, model=self.name)
@@ -309,7 +329,7 @@ class ModelWorker:
 
 class _SearchReq:
     __slots__ = ("q", "rows", "k", "kb", "nprobe", "tier", "deadline",
-                 "arrival", "event", "result", "error")
+                 "arrival", "event", "result", "error", "trace")
 
     def __init__(self, q, k: int, kb: int, nprobe: int, tier: str,
                  deadline: float, arrival: float):
@@ -324,6 +344,7 @@ class _SearchReq:
         self.event = threading.Event()
         self.result = None
         self.error: Optional[Exception] = None
+        self.trace: Optional[fleet.TraceContext] = None
 
     @property
     def key(self):
@@ -418,6 +439,7 @@ class SearchWorker:
         if deadline_s is None:
             deadline_s = self.config.default_deadline_s
         r = _SearchReq(q, int(k), kb, p, tier, now + deadline_s, now)
+        r.trace = fleet.current_trace()
         lkey = f"{self.name}:{tier}"
         if self.admission.infeasible(lkey, r.rows, r.deadline, now):
             self._shed(r, "deadline")
@@ -527,16 +549,19 @@ class SearchWorker:
                   if bucketing.bucketing_enabled() else total)
         lkey = f"{self.name}:{batch[0].tier}"
         try:
-            qs = (batch[0].q if len(batch) == 1
-                  else np.concatenate([r.q for r in batch], axis=0))
-            t0 = time.perf_counter()
-            # dispatch at the shared kb so every member's slice equals its
-            # solo response bit-for-bit (row-independent kernels, stable
-            # column prefix of one top-kb result)
-            ids, dists = self.index.search(
-                qs, k=batch[0].kb, nprobe=batch[0].nprobe or None,
-                tier=batch[0].tier)
-            dt = time.perf_counter() - t0
+            with obs.span("search.dispatch", index=self.name,
+                          tier=batch[0].tier, rows=int(total),
+                          **_trace_attrs(batch)):
+                qs = (batch[0].q if len(batch) == 1
+                      else np.concatenate([r.q for r in batch], axis=0))
+                t0 = time.perf_counter()
+                # dispatch at the shared kb so every member's slice equals
+                # its solo response bit-for-bit (row-independent kernels,
+                # stable column prefix of one top-kb result)
+                ids, dists = self.index.search(
+                    qs, k=batch[0].kb, nprobe=batch[0].nprobe or None,
+                    tier=batch[0].tier)
+                dt = time.perf_counter() - t0
             self.latency.observe(lkey, bucket, dt)
             self._batches.inc(model=self.name)
             self._batch_rows.observe(total, model=self.name)

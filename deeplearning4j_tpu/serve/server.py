@@ -160,6 +160,8 @@ class InferenceServer:
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
                 self.send_header("Transfer-Encoding", "chunked")
+                if self.trace is not None:
+                    self.send_header("traceparent", self.trace.header())
                 self.end_headers()
                 try:
                     for i, tok in enumerate(stream):
@@ -172,6 +174,11 @@ class InferenceServer:
                         tail["ttft_ms"] = round(stream.ttft_s * 1e3, 3)
                 except Exception as e:
                     tail = {"done": True, "reason": "error", "error": str(e)}
+                if self.trace is not None:
+                    # end-to-end correlation over chunked HTTP: the terminal
+                    # line names the trace so a client can resolve its
+                    # request in the merged Perfetto timeline
+                    tail["request_id"] = self.trace.trace_id
                 try:
                     self._chunk(json.dumps(tail).encode() + b"\n")
                     self.wfile.write(b"0\r\n\r\n")
@@ -220,12 +227,15 @@ class InferenceServer:
                     return self.send_json(400, {"error": str(e)})
                 except Exception as e:
                     return self.send_json(500, {"error": str(e)})
-                return self.send_json(200, {
+                body = {
                     "ids": ids.tolist(),
                     "distances": dists.tolist(),
                     "tier": tier_used,
                     "rows": int(len(ids)),
-                })
+                }
+                if self.trace is not None:
+                    body["request_id"] = self.trace.trace_id
+                return self.send_json(200, body)
 
             def handle_knn(self, by_vector: bool) -> int:
                 """Legacy NearestNeighborsServer contract: /knn looks up an
@@ -314,10 +324,13 @@ class InferenceServer:
                     return self.send_json(400, {"error": str(e)})
                 except Exception as e:
                     return self.send_json(500, {"error": str(e)})
-                return self.send_json(200, {
+                body = {
                     "outputs": np.asarray(out).tolist(),
                     "rows": int(len(out)),
-                })
+                }
+                if self.trace is not None:
+                    body["request_id"] = self.trace.trace_id
+                return self.send_json(200, body)
 
         self._httpd, self._thread, self.port = httpcommon.start_server(
             Handler, port)

@@ -60,6 +60,7 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu import obs
+from deeplearning4j_tpu.obs import fleet
 from deeplearning4j_tpu.parallel import compress as compression
 from deeplearning4j_tpu.parallel.elastic import (
     ElasticRuntime,
@@ -243,6 +244,14 @@ class ElasticTrainer:
                   "(owner + R-1 mirrors, capped at world size)").set(
                       self.replication)
         self.stall_s = 0.0   # cumulative boundary time blocked on payloads
+        # fleet observability: slice identity on every span/event, per-rank
+        # step-wall skew detection (rank 0 evaluates), snapshot publication
+        # throttle (report-time: at most ~1/s into the store)
+        if self.slice is not None:
+            obs.set_process_context(slice=str(slice_spec))
+        self._straggler = fleet.StragglerDetector()
+        self._stragglers: set = set()
+        self._last_publish = 0.0
         self._build_plan()
         _, self._bwd, _ = model._get_phase_fns()
         self._base_rng = model._rng
@@ -708,7 +717,7 @@ class ElasticTrainer:
             obs.event("rack_partition", phase="end", wid=self.wid,
                       rack=self.rt.rack, rank=rank, iteration=it)
         chaos.maybe_preempt(it)
-        chaos.maybe_slow(it)
+        chaos.maybe_slow(it, rank=rank)
 
     def _vshard_payload(self, j: int, xb, yb, it: int):
         """Compute vshard ``j``'s weighted contribution and frame it for the
@@ -1025,6 +1034,10 @@ class ElasticTrainer:
         sync = (self.epoch, self.step_in_epoch, it)
         r = view.rank_of(self.wid)
         W = view.world
+        # the work-wall window opens BEFORE the chaos hooks: an injected
+        # slow_iter stall is exactly the straggler signal the skew
+        # detector exists to catch
+        t_start = time.monotonic()
         self._chaos_hooks(it, r)
         self.rt.poll_boundary(sync)
         g = view.gen
@@ -1080,14 +1093,43 @@ class ElasticTrainer:
         finally:
             for f in fetchers:
                 f.stop()
+        stall = self.stall_s - stall0
         obs.histogram("dl4j_elastic_boundary_stall_seconds",
                       "Per-step time blocked waiting on DCN payloads "
-                      "(vshards + param segments)").observe(
-                          self.stall_s - stall0)
+                      "(vshards + param segments)").observe(stall)
+        # straggler detection input: the WORK wall (total minus time spent
+        # blocked on peers' payloads). Total walls equalize across ranks —
+        # every waiter stalls on the straggler — so only the stall-free
+        # component attributes the skew to the rank that caused it.
+        work_s = max(time.monotonic() - t_start - stall, 0.0)
+        self._publish_stepwall(g, it, r, W, work_s)
         if r == 0 and it >= 2:
             self.store.prune(f"grad/{g}/{it - 2}")
             self.store.prune(f"pseg/{g}/{it - 2}")
+            self.store.prune(f"obs/stepwall/{g}/{it - 2}")
         return float(loss)
+
+    def _publish_stepwall(self, g: int, it: int, r: int, W: int,
+                          work_s: float) -> None:
+        """Publish this rank's per-step work wall and (on rank 0) evaluate
+        the skew detector over iteration ``it - 1``, whose walls every
+        rank is guaranteed to have published — the pseg exchange of step
+        ``it`` cannot complete before every rank finished step ``it - 1``
+        — so the read loop below never waits."""
+        try:
+            self.store.set(fleet.stepwall_key(g, it, r),
+                           json.dumps({"wall_s": work_s}).encode())
+            if r != 0 or it < 1 or W < 2:
+                return
+            walls: Dict[int, float] = {}
+            for t in range(W):
+                raw = self.store.get(fleet.stepwall_key(g, it - 1, t))
+                if raw is None:
+                    return  # gen reformed mid-window: skip this boundary
+                walls[t] = float(json.loads(raw.decode())["wall_s"])
+            self._stragglers.update(self._straggler.observe(it - 1, walls))
+        except Exception:
+            pass  # observability must never fail the step
 
     # -- distributed checkpoints ---------------------------------------------
     def _maybe_checkpoint(self):
@@ -1319,6 +1361,7 @@ class ElasticTrainer:
                     self.step_in_epoch = 0
                     self.epoch += 1
                 self._maybe_checkpoint()
+                self._maybe_publish_snapshot()
             while True:
                 try:
                     self._final_gather()
@@ -1329,6 +1372,7 @@ class ElasticTrainer:
                 self._publish_done()
         except _JobDone:
             pass
+        self._maybe_publish_snapshot(force=True)
         view = self.rt.view
         return {
             "wid": self.wid,
@@ -1344,7 +1388,21 @@ class ElasticTrainer:
             "rack": self.rt.rack,
             "store_backend": getattr(self.store, "backend", "file"),
             "async_exchange": bool(self.async_exchange),
+            "stragglers": sorted(self._stragglers),
         }
+
+    def _maybe_publish_snapshot(self, force: bool = False) -> None:
+        """Publish this worker's metrics snapshot for the fleet collector —
+        report-time only, throttled to ~1/s so the store sees one small
+        write per worker per second, not per step."""
+        now = time.monotonic()
+        if not force and now - self._last_publish < 1.0:
+            return
+        self._last_publish = now
+        try:
+            fleet.publish_snapshot(self.store, self.wid)
+        except Exception:
+            pass  # observability must never fail training
 
     def _rows_per_vshard(self, bs: int) -> int:
         """Padded rows per vshard micro-batch; rounded up to the slice's
@@ -1417,6 +1475,10 @@ def _cmd_worker(args) -> int:
                              batch_size=args.batch)
     finally:
         trainer.close()
+        # span dump for the merged fleet timeline (trace_export merge):
+        # one file per worker, each carrying its own wall<->perf anchor
+        # and rank/incarnation process context
+        obs.save_spans(os.path.join(args.outdir, f"spans_{args.id}.json"))
     params = {}
     for key, p in enumerate(model.params):
         for li, leaf in enumerate(jax.tree_util.tree_leaves(p)):
@@ -1492,6 +1554,14 @@ def _cmd_launch(args) -> int:
     wids = [f"w{i}" for i in range(int(args.workers))]
     for wid in wids:
         procs[wid] = spawn(wid, chaos=True)
+    if args.fleet_port >= 0:
+        # fleet metrics federation: serve the merged exposition of every
+        # worker's published snapshot while the run is live
+        from deeplearning4j_tpu.obs import fleet as fleet_mod
+
+        _, _, bound = fleet_mod.serve_collector(open_store(args.store),
+                                                port=args.fleet_port)
+        print(json.dumps({"fleet_port": bound}), flush=True)
     deadline = time.monotonic() + float(args.timeout)
     done: Dict[str, int] = {}
     while len(done) < len(wids):
@@ -1591,6 +1661,10 @@ def _parser() -> argparse.ArgumentParser:
                         "device_count)")
     l.add_argument("--relaunch", type=int, default=0,
                    help="relaunch budget for killed workers (rejoin path)")
+    l.add_argument("--fleet-port", dest="fleet_port", type=int, default=-1,
+                   help="serve the fleet metrics collector "
+                        "(/fleet/metrics) on this port while workers run "
+                        "(0 = OS-assigned; -1 = off)")
     l.add_argument("--allow-failures", dest="allow_failures", type=int,
                    default=0)
     l.add_argument("--timeout", type=float, default=300.0)

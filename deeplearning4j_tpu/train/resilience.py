@@ -615,8 +615,10 @@ class ChaosInjector:
       a real SIGKILL (``:kill``). Fires once per process.
     - ``nan_grad[@iter:K]`` — NaN-poison the batch features of iteration K
       (every float activation/gradient downstream goes NaN). Fires once.
-    - ``slow_iter[@iter:K][:seconds]`` — sleep before the step (default
-      0.05 s); without an anchor, every step (a stalled iterator).
+    - ``slow_iter[@iter:K][:rankN][:seconds]`` — sleep before the step
+      (default 0.05 s); without an anchor, every step (a stalled iterator).
+      A ``rankN`` target limits the stall to one data-parallel rank — the
+      deterministic straggler the fleet skew detector must flag.
     - ``corrupt_ckpt[@ckpt:K][:truncate|bitflip]`` — damage checkpoint
       number K (or the first one written) AFTER its CRC is recorded, so
       validation must catch it. Fires once.
@@ -670,15 +672,22 @@ class ChaosInjector:
                 raise ChaosPreemption(
                     f"chaos: preempted at iteration {iteration}")
 
-    def maybe_slow(self, iteration: int) -> None:
+    def maybe_slow(self, iteration: int, *, rank: Optional[int] = None) -> None:
         for f in self.faults:
             if f.kind != "slow_iter":
+                continue
+            # rank-targeted straggler injection (``slow_iter:rank1:0.5``):
+            # only the targeted data-parallel rank stalls, so the skew is
+            # attributable — the straggler detector's test fixture
+            target, rest = self._rank_arg(f.arg)
+            if target is not None and (rank is None or rank != target):
                 continue
             if f.at_iter is None or (iteration == f.at_iter and not f.fired):
                 if f.at_iter is not None:
                     f.fired = True
-                    obs.event("chaos", fault="slow_iter", iteration=iteration)
-                time.sleep(float(f.arg) if f.arg else 0.05)
+                    obs.event("chaos", fault="slow_iter", iteration=iteration,
+                              rank=rank)
+                time.sleep(float(rest) if rest else 0.05)
 
     def maybe_nan_batch(self, iteration: int, x):
         for f in self.faults:

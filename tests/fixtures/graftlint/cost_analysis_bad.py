@@ -1,10 +1,11 @@
 """graftlint fixture: cost-analysis-off-hot-path true positives —
-HLO cost walks and trace export reachable from traced / per-batch code."""
+HLO cost walks, trace export and fleet federation reachable from
+traced / per-batch code."""
 
 import jax
 import jax.numpy as jnp
 
-from deeplearning4j_tpu.obs import trace_export
+from deeplearning4j_tpu.obs import fleet, trace_export
 
 
 def fwd(params, x):
@@ -39,6 +40,18 @@ def step_suppressed(compiled, params, x):
     out = _jit_fwd(params, x)
     stats = compiled.memory_analysis()  # graftlint: disable=cost-analysis-off-hot-path
     return out, stats
+
+
+def step_publish(store, params, x):
+    out = _jit_fwd(params, x)
+    fleet.publish_snapshot(store, "w0")     # BAD: store I/O per dispatch
+    return out
+
+
+def step_collect(store, params, x):
+    out = _jit_fwd(params, x)
+    snaps = fleet.FleetCollector(store).collect_snapshots()  # BAD: scan
+    return out, snaps
 
 
 def step_ok(params, x):

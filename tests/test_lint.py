@@ -127,6 +127,9 @@ class TestRuleTruePositives:
         assert _hits(fs, rule, "cost_analysis_bad.py", "step_mem")
         # trace export inside a traced body
         assert _hits(fs, rule, "cost_analysis_bad.py", "step_traced.body")
+        # fleet federation (snapshot publish / collector scan) per dispatch
+        assert _hits(fs, rule, "cost_analysis_bad.py", "step_publish")
+        assert _hits(fs, rule, "cost_analysis_bad.py", "step_collect")
         # plain dict lookups on the dispatch path stay allowed
         assert not _hits(fs, rule, "cost_analysis_bad.py", "step_ok")
 

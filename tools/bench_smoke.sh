@@ -36,7 +36,10 @@ if [ "$rc" -ne 1 ]; then
 fi
 echo "bench smoke OK: graftlint clean, exit-code contract (0/1/2) holds"
 
-BENCH_SMOKE=1 JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python bench.py | tee "$out"
+# DL4J_TPU_RANK/WID: run the whole harness with fleet span/event stamping
+# live — the obs-overhead arm must absorb it inside its existing budget
+BENCH_SMOKE=1 JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} \
+DL4J_TPU_RANK=0 DL4J_TPU_WID=bench python bench.py | tee "$out"
 
 # every registered metric present, none carrying an "error" field, and every
 # one embedding its obs.snapshot() (docs/OBSERVABILITY.md). The output goes
